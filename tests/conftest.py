@@ -1,0 +1,29 @@
+"""Test harness: force CPU with 8 virtual devices BEFORE jax backends init.
+
+Sharded/pjit code paths are exercised deterministically on an 8-device CPU
+mesh (SURVEY.md §5 item 5) — no pod required. Bench runs (bench.py) use the
+real TPU chip instead.
+
+Note: this environment preloads jax at interpreter startup (sitecustomize)
+with JAX_PLATFORMS=axon, so setting the env var here is too late for jax's
+config — we must update `jax.config` directly. XLA_FLAGS is still read from
+the environment at (lazy) backend-init time, so setting it here works.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu", (
+    "tests require the CPU backend; jax backends were initialized before "
+    "conftest could override the platform"
+)

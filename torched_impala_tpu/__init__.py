@@ -1,0 +1,21 @@
+"""torched_impala_tpu — a TPU-native IMPALA actor-learner framework.
+
+A from-scratch reimplementation of the capabilities of
+`threewisemonkeys-as/torched_impala` (see SURVEY.md; the reference mount was
+empty at survey time, so the capability contract in SURVEY.md §1 is the spec),
+designed TPU-first. Target architecture (subpackages land incrementally —
+check each subpackage's __init__ for what is implemented):
+
+- V-trace as a `jax.lax.scan` reverse-time recursion with a Pallas TPU kernel
+  variant (`ops/`).
+- Flax policy zoo: MLP, Nature-CNN, IMPALA deep ResNet + LSTM reset core,
+  PopArt value normalization (`models/`).
+- CPU actors stepping gymnasium envs, feeding a double-buffered host→device
+  pipeline into a jit/pjit-compiled learner (`runtime/`).
+- Data-parallel learner over a `jax.sharding.Mesh` with gradient all-reduce
+  over ICI; mesh layout leaves room for a model axis (`parallel/`).
+- Checkpoint/resume (orbax), eval runner, metrics, typed configs (`utils/`,
+  `configs.py`, `run.py`).
+"""
+
+__version__ = "0.1.0"

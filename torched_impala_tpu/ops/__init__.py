@@ -1,0 +1,36 @@
+"""RL math ops: V-trace, losses, PopArt — pure functions over [T, B] arrays.
+
+Note: the `vtrace` name is the *submodule*; the dispatching function is
+`torched_impala_tpu.ops.vtrace.vtrace` (re-exported here as `vtrace_fn` to
+avoid shadowing the submodule attribute).
+"""
+
+from torched_impala_tpu.ops import vtrace  # noqa: F401  (submodule)
+from torched_impala_tpu.ops.vtrace import (  # noqa: F401
+    VTraceOutput,
+    importance_ratios,
+    vtrace_scan,
+)
+from torched_impala_tpu.ops.vtrace import vtrace as vtrace_fn  # noqa: F401
+from torched_impala_tpu.ops.losses import (  # noqa: F401
+    ImpalaLossConfig,
+    LossOutput,
+    baseline_loss,
+    entropy_loss,
+    impala_loss,
+    policy_gradient_loss,
+)
+
+__all__ = [
+    "VTraceOutput",
+    "importance_ratios",
+    "vtrace",
+    "vtrace_fn",
+    "vtrace_scan",
+    "ImpalaLossConfig",
+    "LossOutput",
+    "baseline_loss",
+    "entropy_loss",
+    "impala_loss",
+    "policy_gradient_loss",
+]

@@ -1,0 +1,167 @@
+"""Pallas TPU kernel for the V-trace recursion (BASELINE.json:5's "Pallas
+fallback for the time-major inner loop").
+
+One fused VMEM-resident kernel computes, per 128-lane batch tile:
+ratio clipping → deltas → the reverse-time linear recurrence → vs targets →
+policy-gradient advantages. The grid runs over the batch axis (the recursion
+is sequential in T but embarrassingly parallel in B); each program keeps its
+whole `[T, 128]` tile in VMEM, so the T-loop never touches HBM.
+
+Semantically identical to `vtrace.vtrace_scan` (asserted in
+tests/test_pallas_vtrace.py); both sit behind `vtrace.vtrace(...,
+implementation=...)`.
+
+Outputs are V-trace *targets* — constants w.r.t. all inputs (stop_gradient
+semantics), so the kernel needs no custom VJP; the wrapper blocks gradient
+flow explicitly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from torched_impala_tpu.ops.vtrace import VTraceOutput
+
+_LANES = 128
+
+
+def _vtrace_kernel(
+    log_rhos_ref,
+    discounts_ref,
+    rewards_ref,
+    values_ref,
+    bootstrap_ref,
+    vs_ref,
+    pg_ref,
+    err_ref,
+    a_scratch,
+    *,
+    clip_rho: float,
+    clip_c: float,
+    clip_pg_rho: float,
+    lambda_: float,
+    T: int,
+):
+    rhos = jnp.exp(log_rhos_ref[:])  # [T, 128]
+    discounts = discounts_ref[:]
+    values = values_ref[:]
+    bootstrap = bootstrap_ref[0, :]  # [128]
+
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = lambda_ * jnp.minimum(clip_c, rhos)
+    values_tp1 = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+    deltas = clipped_rhos * (rewards_ref[:] + discounts * values_tp1 - values)
+
+    # Stage the recursion operands in refs so the T-loop uses dynamic-slice
+    # reads/writes on memory instead of gathers on traced arrays.
+    err_ref[:] = deltas
+    a_scratch[:] = discounts * cs
+
+    def body(i, acc):
+        t = T - 1 - i
+        acc = err_ref[pl.ds(t, 1), :] + a_scratch[pl.ds(t, 1), :] * acc
+        err_ref[pl.ds(t, 1), :] = acc
+        return acc
+
+    jax.lax.fori_loop(0, T, body, jnp.zeros((1, _LANES), values.dtype))
+
+    vs = values + err_ref[:]
+    vs_ref[:] = vs
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    pg_ref[:] = clipped_pg_rhos * (rewards_ref[:] + discounts * vs_tp1 - values)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "clip_rho_threshold",
+        "clip_c_threshold",
+        "clip_pg_rho_threshold",
+        "lambda_",
+        "interpret",
+    ),
+)
+def vtrace_pallas(
+    *,
+    log_rhos: jax.Array,
+    discounts: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    clip_rho_threshold: float = 1.0,
+    clip_c_threshold: float = 1.0,
+    clip_pg_rho_threshold: float = 1.0,
+    lambda_: float = 1.0,
+    interpret: bool | None = None,
+) -> VTraceOutput:
+    """V-trace via the fused Pallas TPU kernel. Same contract as `vtrace_scan`.
+
+    `interpret=None` auto-selects interpreter mode off-TPU so tests and CPU
+    meshes run the same code path.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T, B = rewards.shape
+    f32 = jnp.float32
+
+    def prep(x):
+        # V-trace outputs are targets (constants); stopping gradients on the
+        # *inputs* keeps jax.grad from tracing a (nonexistent) JVP rule
+        # through pallas_call.
+        return jax.lax.stop_gradient(jnp.asarray(x, f32))
+
+    log_rhos, discounts, rewards, values = map(
+        prep, (log_rhos, discounts, rewards, values)
+    )
+    bootstrap = prep(bootstrap_value)[None, :]  # [1, B]
+
+    # Pad the batch axis to full 128-wide lanes; lanes beyond B compute
+    # garbage independently and are sliced off (no cross-lane ops).
+    Bp = max(_LANES, ((B + _LANES - 1) // _LANES) * _LANES)
+    pad = Bp - B
+    if pad:
+        padding = ((0, 0), (0, pad))
+        log_rhos, discounts, rewards, values, bootstrap = (
+            jnp.pad(x, padding)
+            for x in (log_rhos, discounts, rewards, values, bootstrap)
+        )
+
+    kernel = functools.partial(
+        _vtrace_kernel,
+        clip_rho=float("inf")
+        if clip_rho_threshold is None
+        else clip_rho_threshold,
+        clip_c=float("inf") if clip_c_threshold is None else clip_c_threshold,
+        clip_pg_rho=float("inf")
+        if clip_pg_rho_threshold is None
+        else clip_pg_rho_threshold,
+        lambda_=lambda_,
+        T=T,
+    )
+    tb_spec = pl.BlockSpec((T, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
+    boot_spec = pl.BlockSpec(
+        (1, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    out_struct = jax.ShapeDtypeStruct((T, Bp), f32)
+    vs, pg, err = pl.pallas_call(
+        kernel,
+        grid=(Bp // _LANES,),
+        in_specs=[tb_spec, tb_spec, tb_spec, tb_spec, boot_spec],
+        out_specs=(tb_spec, tb_spec, tb_spec),
+        out_shape=(out_struct, out_struct, out_struct),
+        scratch_shapes=[pltpu.VMEM((T, _LANES), f32)],
+        interpret=interpret,
+    )(log_rhos, discounts, rewards, values, bootstrap)
+
+    vs, pg, err = (x[:, :B] for x in (vs, pg, err))
+    return VTraceOutput(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg),
+        errors=jax.lax.stop_gradient(err),
+    )
