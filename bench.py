@@ -1,0 +1,101 @@
+"""Benchmark: learner frames/sec/chip on the Atari Pong config.
+
+Measures the steady-state throughput of the full jit-compiled learner train
+step (unroll re-forward of the Nature-CNN policy, V-trace, loss, backward,
+RMSProp update) on device-resident synthetic [T, B] Atari batches — the
+"learner frames/sec/chip" half of the BASELINE.json:2 metric. Env stepping
+and H2D are excluded here (they are host-side and scale with actor count);
+the learner step is the TPU-bound hot loop this metric tracks.
+
+Prints ONE JSON line. `vs_baseline` is value / 62_500: the reference has no
+published numbers (BASELINE.md), so the yardstick is the north-star target of
+1M env-frames/s on a v5e-16 (BASELINE.json:5) prorated to one chip
+(1_000_000 / 16 = 62_500 frames/s/chip).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
+    from torched_impala_tpu.ops import ImpalaLossConfig
+    from torched_impala_tpu.runtime import Learner, LearnerConfig
+
+    T, B = 20, 256
+    num_actions = 6  # Pong
+    log(f"bench: backend={jax.default_backend()} T={T} B={B}")
+
+    agent = Agent(
+        ImpalaNet(num_actions=num_actions, torso=AtariShallowTorso())
+    )
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
+        config=LearnerConfig(
+            batch_size=B,
+            unroll_length=T,
+            loss=ImpalaLossConfig(reduction="sum"),
+            publish_interval=1_000_000,  # exclude host publication from timing
+        ),
+        example_obs=np.zeros((84, 84, 4), np.uint8),
+        rng=jax.random.key(0),
+    )
+
+    rng = np.random.default_rng(0)
+    arrays = (
+        jnp.asarray(
+            rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
+        ),
+        jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
+        jnp.asarray(rng.integers(0, num_actions, size=(T, B), dtype=np.int32)),
+        jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
+        jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+        jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
+        (),
+    )
+    arrays = jax.device_put(arrays)
+
+    params, opt_state = learner.params, learner.opt_state
+    # Warmup/compile.
+    params, opt_state, logs = learner._train_step(params, opt_state, *arrays)
+    jax.block_until_ready(logs)
+    log(f"bench: compiled, total_loss={float(logs['total_loss']):.3f}")
+
+    steps = 30
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, logs = learner._train_step(
+            params, opt_state, *arrays
+        )
+    jax.block_until_ready(logs)
+    dt = time.perf_counter() - t0
+
+    frames_per_sec = T * B * steps / dt
+    n_chips = max(1, len(jax.devices()))
+    value = frames_per_sec / n_chips
+    result = {
+        "metric": "learner_frames_per_sec_per_chip_pong",
+        "value": round(value, 1),
+        "unit": "frames/s/chip",
+        "vs_baseline": round(value / 62_500.0, 3),
+    }
+    log(
+        f"bench: {steps} steps in {dt:.3f}s -> {frames_per_sec:,.0f} frames/s "
+        f"on {n_chips} chip(s)"
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

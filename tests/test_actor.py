@@ -1,0 +1,130 @@
+"""Actor unit tests: trajectory shapes/alignment, param sync, push path.
+
+Mirrors the analog's test strategy (SURVEY.md §5): real toy env + real agent
++ mocked learner side.
+"""
+
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torched_impala_tpu.envs import ScriptedEnv
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.runtime import Actor, ParamStore
+
+
+def _agent_and_params(use_lstm=False, num_actions=2, obs_size=4):
+    net = ImpalaNet(
+        num_actions=num_actions,
+        torso=MLPTorso(hidden_sizes=(8,)),
+        use_lstm=use_lstm,
+        lstm_size=6,
+    )
+    agent = Agent(net)
+    params = agent.init_params(
+        jax.random.key(0), jnp.zeros((obs_size,), jnp.float32)
+    )
+    return agent, params
+
+
+def _make_actor(agent, params, T=8, episode_len=5, enqueue=None):
+    store = ParamStore()
+    store.publish(0, params)
+    return Actor(
+        actor_id=3,
+        env=ScriptedEnv(episode_len=episode_len),
+        agent=agent,
+        param_store=store,
+        enqueue=enqueue or (lambda t: None),
+        unroll_length=T,
+        seed=1,
+    )
+
+
+def test_unroll_shapes_and_alignment():
+    T, ep = 8, 5
+    agent, params = _agent_and_params()
+    actor = _make_actor(agent, params, T=T, episode_len=ep)
+    traj = actor.unroll(params)
+
+    assert traj.obs.shape == (T + 1, 4)
+    assert traj.first.shape == (T + 1,)
+    assert traj.actions.shape == (T,)
+    assert traj.behaviour_logits.shape == (T, 2)
+    assert traj.rewards.shape == (T,)
+    assert traj.cont.shape == (T,)
+    assert traj.actor_id == 3
+
+    # ScriptedEnv: episodes end every `ep` steps; rewards all 1.
+    np.testing.assert_array_equal(traj.rewards, np.ones(T))
+    # Steps t=0..T-1; done fires on the ep-th step (t = ep-1).
+    expected_cont = np.ones(T, np.float32)
+    expected_cont[ep - 1] = 0.0
+    np.testing.assert_array_equal(traj.cont, expected_cont)
+    expected_first = np.zeros(T + 1, bool)
+    expected_first[0] = True  # env was just reset
+    expected_first[ep] = True  # obs after the terminal step
+    np.testing.assert_array_equal(traj.first, expected_first)
+    # Bootstrap obs carried over: next unroll starts where this one ended.
+    traj2 = actor.unroll(params)
+    np.testing.assert_array_equal(traj2.obs[0], traj.obs[-1])
+    assert traj2.first[0] == traj.first[-1]
+
+
+def test_unroll_carries_lstm_state():
+    T = 6
+    agent, params = _agent_and_params(use_lstm=True)
+    actor = _make_actor(agent, params, T=T)
+    t1 = actor.unroll(params)
+    # First unroll starts from the zero state.
+    for leaf in jax.tree.leaves(t1.agent_state):
+        np.testing.assert_array_equal(leaf, np.zeros_like(leaf))
+    t2 = actor.unroll(params)
+    # Second unroll starts from the state reached after T steps — nonzero.
+    assert any(
+        np.abs(leaf).sum() > 0 for leaf in jax.tree.leaves(t2.agent_state)
+    )
+
+
+def test_param_sync_from_store():
+    agent, params = _agent_and_params()
+    store = ParamStore()
+    store.publish(1234, params)
+    version, got = store.get()
+    assert version == 1234
+    jax.tree.map(np.testing.assert_array_equal, got, params)
+
+
+def test_push_path_calls_enqueue_once():
+    agent, params = _agent_and_params()
+    enqueue = mock.MagicMock()
+    actor = _make_actor(agent, params, T=5, enqueue=enqueue)
+    actor.unroll_and_push()
+    assert enqueue.call_count == 1
+    (traj,), _ = enqueue.call_args
+    assert traj.obs.shape[0] == 6
+    assert traj.param_version == 0
+
+
+def test_episode_return_callback():
+    agent, params = _agent_and_params()
+    returns = []
+    store = ParamStore()
+    store.publish(0, params)
+    actor = Actor(
+        actor_id=0,
+        env=ScriptedEnv(episode_len=3),
+        agent=agent,
+        param_store=store,
+        enqueue=lambda t: None,
+        unroll_length=10,
+        seed=0,
+        on_episode_return=lambda aid, ret, length: returns.append(
+            (aid, ret, length)
+        ),
+    )
+    actor.unroll(params)
+    # 10 steps with 3-step episodes => 3 completed episodes, return 3 each.
+    assert returns == [(0, 3.0, 3), (0, 3.0, 3), (0, 3.0, 3)]

@@ -1,0 +1,202 @@
+"""Learner tests: single-process integration (one push → one step) and the
+full threaded CartPole smoke showing learning (SURVEY.md §5 items 4).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.envs import ScriptedEnv, make_cartpole
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.ops import ImpalaLossConfig
+from torched_impala_tpu.runtime import (
+    Actor,
+    Learner,
+    LearnerConfig,
+    stack_trajectories,
+    train,
+)
+
+
+def _agent(obs_size=4, num_actions=2, use_lstm=False):
+    return Agent(
+        ImpalaNet(
+            num_actions=num_actions,
+            torso=MLPTorso(hidden_sizes=(16,)),
+            use_lstm=use_lstm,
+            lstm_size=8,
+        )
+    )
+
+
+@pytest.mark.parametrize("use_lstm", [False, True])
+def test_integration_one_push_one_step(use_lstm):
+    """The minimum end-to-end slice: real env, real agent, one unroll pushed,
+    one learner SGD step taken (shape of `learner_test.py:29-56`)."""
+    T, B = 6, 2
+    agent = _agent(use_lstm=use_lstm)
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(batch_size=B, unroll_length=T),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+    )
+    _, params = learner.param_store.get()
+    actor = Actor(
+        actor_id=0,
+        env=ScriptedEnv(episode_len=4),
+        agent=agent,
+        param_store=learner.param_store,
+        enqueue=learner.enqueue,
+        unroll_length=T,
+        seed=0,
+    )
+    for _ in range(B):
+        actor.unroll_and_push()
+    learner.start()
+    logs = learner.step_once(timeout=30)
+    learner.stop()
+
+    assert np.isfinite(logs["total_loss"])
+    assert logs["num_frames"] == T * B
+    # Acted with version-0 params, trained after counting this batch's
+    # frames: lag is exactly one batch.
+    assert logs["param_lag_frames"] == T * B
+    # Params actually moved.
+    _, new_params = learner.param_store.get()
+    diffs = jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).sum()),
+        params,
+        new_params,
+    )
+    assert sum(jax.tree.leaves(diffs)) > 0
+
+
+def test_stack_trajectories_shapes():
+    agent = _agent(use_lstm=True)
+    params = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    from torched_impala_tpu.runtime import ParamStore
+
+    store = ParamStore()
+    store.publish(7, params)
+    actor = Actor(
+        actor_id=0,
+        env=ScriptedEnv(),
+        agent=agent,
+        param_store=store,
+        enqueue=lambda t: None,
+        unroll_length=5,
+        seed=0,
+    )
+    trajs = [actor.unroll(params, 7) for _ in range(3)]
+    batch = stack_trajectories(trajs)
+    assert batch.obs.shape == (6, 3, 4)
+    assert batch.behaviour_logits.shape == (5, 3, 2)
+    assert batch.agent_state[0].shape == (3, 8)
+    assert batch.param_version == 7
+
+
+def test_backpressure_and_queue_closed():
+    """Bounded queue blocks producers; stop() releases them with QueueClosed."""
+    from torched_impala_tpu.runtime import QueueClosed
+
+    agent = _agent()
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(batch_size=1, unroll_length=2, queue_capacity=1),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+    )
+    _, params = learner.param_store.get()
+    actor = Actor(
+        actor_id=0,
+        env=ScriptedEnv(),
+        agent=agent,
+        param_store=learner.param_store,
+        enqueue=learner.enqueue,
+        unroll_length=2,
+        seed=0,
+    )
+    actor.unroll_and_push()  # fills the queue (capacity 1)
+    blocked = threading.Event()
+    raised = threading.Event()
+
+    def push_again():
+        blocked.set()
+        try:
+            actor.unroll_and_push()
+        except QueueClosed:
+            raised.set()
+
+    t = threading.Thread(target=push_again, daemon=True)
+    t.start()
+    assert blocked.wait(5)
+    learner.stop()
+    t.join(timeout=5)
+    assert raised.is_set()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_watchdog_raises_when_all_actors_die():
+    """SURVEY.md §6 failure detection: a job whose producers all crashed must
+    fail loudly, not hang. (Actor threads re-raise by design — hence the
+    unhandled-thread-exception filter.)"""
+
+    class ExplodingEnv:
+        def reset(self, seed=None):
+            return np.zeros(4, np.float32), {}
+
+        def step(self, action):
+            raise RuntimeError("env exploded")
+
+    agent = _agent()
+    with pytest.raises(RuntimeError, match="all actor threads are dead"):
+        train(
+            agent=agent,
+            env_factory=lambda seed: ExplodingEnv(),
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(batch_size=2, unroll_length=4),
+            optimizer=optax.sgd(1e-2),
+            total_steps=5,
+            seed=0,
+        )
+
+
+def test_cartpole_smoke_learns():
+    """CartPole-v1, MLP, threaded actors, jit learner: return must rise
+    (BASELINE config 1). Thresholds are loose — this is a smoke test, not a
+    convergence benchmark."""
+    agent = _agent(obs_size=4, num_actions=2)
+    result = train(
+        agent=agent,
+        env_factory=lambda seed: make_cartpole(seed)[0],
+        example_obs=np.zeros((4,), np.float32),
+        num_actors=2,
+        learner_config=LearnerConfig(
+            batch_size=4,
+            unroll_length=20,
+            loss=ImpalaLossConfig(
+                discount=0.99, entropy_coef=0.01, reduction="mean"
+            ),
+        ),
+        optimizer=optax.rmsprop(5e-3, decay=0.99, eps=1e-7),
+        total_steps=250,
+        seed=0,
+    )
+    returns = [r for _, r, _ in result.episode_returns]
+    assert len(returns) >= 20, "too few episodes completed"
+    early = np.mean(returns[: len(returns) // 4])
+    late = np.mean(returns[-len(returns) // 4 :])
+    assert late > early * 1.3, (
+        f"no learning signal: early={early:.1f} late={late:.1f}"
+    )
+    assert result.num_frames == 250 * 4 * 20

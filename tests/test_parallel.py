@@ -1,0 +1,144 @@
+"""Sharded DP learner tests on the 8-virtual-device CPU mesh (SURVEY.md §5
+item 5): the sharded step must execute, keep params replicated, and match the
+single-device step numerically."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.envs import ScriptedEnv
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.parallel import DATA_AXIS, make_mesh
+from torched_impala_tpu.runtime import (
+    Actor,
+    Learner,
+    LearnerConfig,
+    ParamStore,
+)
+
+
+def _agent(use_lstm=False):
+    return Agent(
+        ImpalaNet(
+            num_actions=2,
+            torso=MLPTorso(hidden_sizes=(16,)),
+            use_lstm=use_lstm,
+            lstm_size=8,
+        )
+    )
+
+
+def _collect_batch(agent, params, T, B):
+    store = ParamStore()
+    store.publish(0, params)
+    actor = Actor(
+        actor_id=0,
+        env=ScriptedEnv(episode_len=4),
+        agent=agent,
+        param_store=store,
+        enqueue=lambda t: None,
+        unroll_length=T,
+        seed=0,
+    )
+    return [actor.unroll(params) for _ in range(B)]
+
+
+def _run_learner(agent, trajs, mesh, T, B, lr=1e-2):
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(lr),
+        config=LearnerConfig(batch_size=B, unroll_length=T),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+        mesh=mesh,
+    )
+    for t in trajs:
+        learner.enqueue(t)
+    learner.start()
+    logs = learner.step_once(timeout=60)
+    learner.stop()
+    return learner, logs
+
+
+@pytest.mark.parametrize("use_lstm", [False, True])
+def test_sharded_step_matches_single_device(use_lstm):
+    assert len(jax.devices()) == 8
+    T, B = 5, 8
+    agent = _agent(use_lstm)
+    params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    trajs = _collect_batch(agent, params0, T, B)
+
+    mesh = make_mesh(num_data=8)
+    single, logs_single = _run_learner(agent, list(trajs), None, T, B)
+    sharded, logs_sharded = _run_learner(agent, list(trajs), mesh, T, B)
+
+    np.testing.assert_allclose(
+        logs_single["total_loss"], logs_sharded["total_loss"], rtol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        single.params,
+        sharded.params,
+    )
+
+
+def test_sharded_params_stay_replicated():
+    T, B = 4, 8
+    agent = _agent()
+    params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    trajs = _collect_batch(agent, params0, T, B)
+    mesh = make_mesh(num_data=8)
+    learner, _ = _run_learner(agent, trajs, mesh, T, B)
+    for leaf in jax.tree.leaves(learner.params):
+        assert leaf.sharding.is_fully_replicated
+
+
+def test_mesh_shapes_and_validation():
+    mesh = make_mesh(num_data=4, num_model=2)
+    assert mesh.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(num_data=16)
+    agent = _agent()
+    with pytest.raises(ValueError, match="not divisible"):
+        Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(batch_size=3, unroll_length=4),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+            mesh=make_mesh(num_data=8),
+        )
+
+
+def test_batch_lands_sharded_over_data_axis():
+    """The device batch must actually be partitioned over the data axis —
+    i.e. each device holds B/8 of the batch, not a replica."""
+    T, B = 4, 8
+    agent = _agent()
+    params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    trajs = _collect_batch(agent, params0, T, B)
+    mesh = make_mesh(num_data=8)
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(batch_size=B, unroll_length=T),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+        mesh=mesh,
+    )
+    for t in trajs:
+        learner.enqueue(t)
+    learner.start()
+    (arrays, _version) = learner._batch_q.get(timeout=60)
+    learner.stop()
+    obs = arrays[0]
+    assert obs.shape == (T + 1, B, 4)
+    # Each shard should cover the full time axis but only B/8 of batch.
+    shard_shape = obs.sharding.shard_shape(obs.shape)
+    assert shard_shape == (T + 1, 1, 4)
+    spec = obs.sharding.spec
+    assert spec[1] == DATA_AXIS

@@ -1,0 +1,25 @@
+"""Environment factories, wrappers, and test fakes (SURVEY.md §2 env row)."""
+
+from torched_impala_tpu.envs.factory import (  # noqa: F401
+    FACTORIES,
+    EnvSpec,
+    make_atari,
+    make_cartpole,
+    make_dmlab,
+    make_procgen,
+)
+from torched_impala_tpu.envs.fake import (  # noqa: F401
+    FakeAtariEnv,
+    ScriptedEnv,
+)
+
+__all__ = [
+    "FACTORIES",
+    "EnvSpec",
+    "FakeAtariEnv",
+    "ScriptedEnv",
+    "make_atari",
+    "make_cartpole",
+    "make_dmlab",
+    "make_procgen",
+]

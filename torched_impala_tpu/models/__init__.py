@@ -1,0 +1,26 @@
+"""Flax policy zoo and the stateless Agent API (SURVEY.md §2 rows 3-4)."""
+
+from torched_impala_tpu.models.agent import Agent, AgentOutput  # noqa: F401
+from torched_impala_tpu.models.nets import (  # noqa: F401
+    ImpalaNet,
+    NetOutput,
+    NetState,
+)
+from torched_impala_tpu.models.torsos import (  # noqa: F401
+    AtariDeepTorso,
+    AtariShallowTorso,
+    MLPTorso,
+    ResidualBlock,
+)
+
+__all__ = [
+    "Agent",
+    "AgentOutput",
+    "ImpalaNet",
+    "NetOutput",
+    "NetState",
+    "AtariDeepTorso",
+    "AtariShallowTorso",
+    "MLPTorso",
+    "ResidualBlock",
+]

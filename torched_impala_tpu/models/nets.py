@@ -1,0 +1,131 @@
+"""ImpalaNet: torso + optional LSTM reset core + policy/value heads (Flax).
+
+Two-mode API mirroring the analog's `AtariNet` (`haiku_nets.py:133-172`) and
+the reference's `nn.Module.forward(obs, core_state)` (SURVEY.md §2 Agent row):
+
+- step:   `[B, ...]` single timestep for actors;
+- unroll: `[T, B, ...]` time-major re-forward for the learner, with the
+  recurrent core driven by `lax.scan` (via `nn.scan` so both modes share
+  parameters) and episode-start resets applied to the carry *inside* the scan
+  — the `hk.ResetCore` semantics (`haiku_nets.py:141,159-161`).
+
+TPU notes: the torso is applied to the whole `[T*B, ...]` batch in one call
+(one big MXU-friendly conv/matmul batch, no per-step Python loop); only the
+LSTM recurrence is sequential, as a single fused XLA while-loop.
+
+The value head is always a `num_values`-wide Dense named "value_head" so the
+PopArt rescaling in `ops/popart.py` can address its kernel/bias by a stable
+path; with PopArt enabled its outputs are *normalized* values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NetState = Any  # LSTM carry tuple, or () for feedforward nets.
+
+
+class NetOutput(NamedTuple):
+    """Policy logits `[..., A]` and values `[..., num_values]` (float32)."""
+
+    policy_logits: jax.Array
+    values: jax.Array
+
+
+def _reset_carry(carry, initial_carry, first: jax.Array):
+    """Replace carry rows with the initial carry where `first` is set."""
+
+    def sel(c, c0):
+        m = first.reshape(first.shape + (1,) * (c.ndim - first.ndim))
+        return jnp.where(m, c0, c)
+
+    return jax.tree.map(sel, carry, initial_carry)
+
+
+def _core_step(cell: nn.Module, carry, inputs):
+    """One recurrent step with episode-boundary reset; scanned over time."""
+    x, first = inputs
+    zero_carry = jax.tree.map(jnp.zeros_like, carry)
+    carry = _reset_carry(carry, zero_carry, first)
+    carry, out = cell(carry, x)
+    return carry, out
+
+
+class ImpalaNet(nn.Module):
+    """Policy network: `torso` feature extractor, optional LSTM core, heads.
+
+    Attributes:
+      num_actions: size of the categorical action space.
+      torso: a Flax module mapping `[N, ...obs]` → `[N, F]` features.
+      use_lstm: insert an LSTM(lstm_size) core between torso and heads.
+      lstm_size: LSTM hidden width (reference uses 256, SURVEY.md §1 item 4).
+      num_values: width of the value head (1, or num_tasks under PopArt).
+    """
+
+    num_actions: int
+    torso: nn.Module
+    use_lstm: bool = False
+    lstm_size: int = 256
+    num_values: int = 1
+
+    def initial_state(self, batch_size: int) -> NetState:
+        """Zero recurrent state; a pure function of the config (no params)."""
+        if not self.use_lstm:
+            return ()
+        shape = (batch_size, self.lstm_size)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+    def _heads(self, core_out: jax.Array) -> NetOutput:
+        core_out = core_out.astype(jnp.float32)
+        logits = nn.Dense(self.num_actions, name="policy_head")(core_out)
+        values = nn.Dense(self.num_values, name="value_head")(core_out)
+        return NetOutput(policy_logits=logits, values=values)
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jax.Array,
+        first: jax.Array,
+        state: NetState,
+        *,
+        unroll: bool = False,
+    ) -> tuple[NetOutput, NetState]:
+        """Apply the net.
+
+        Args:
+          obs: `[B, ...]` (step mode) or `[T, B, ...]` (unroll mode).
+          first: bool `[B]` / `[T, B]` episode-start flags; resets the core.
+          state: recurrent carry from `initial_state` or a previous call.
+          unroll: static mode switch (two jit specializations, shared params).
+
+        Returns:
+          (NetOutput, new_state) with leading dims matching the mode.
+        """
+        if unroll:
+            t, b = obs.shape[:2]
+            features = self.torso(obs.reshape(t * b, *obs.shape[2:]))
+            features = features.reshape(t, b, -1)
+        else:
+            features = self.torso(obs)
+
+        if self.use_lstm:
+            cell = nn.OptimizedLSTMCell(self.lstm_size, name="lstm")
+            if unroll:
+                scan = nn.scan(
+                    _core_step,
+                    variable_broadcast="params",
+                    split_rngs={"params": False},
+                    in_axes=0,
+                    out_axes=0,
+                )
+                state, core_out = scan(cell, state, (features, first))
+            else:
+                state, core_out = _core_step(cell, state, (features, first))
+        else:
+            core_out = features
+
+        return self._heads(core_out), state

@@ -1,0 +1,97 @@
+"""Observation torsos: MLP, Nature-CNN, IMPALA deep ResNet (Flax).
+
+Capability parity with the reference's policy-network zoo (SURVEY.md §1
+item 4, reconstructed from BASELINE.json:7-11): 2-layer MLP (CartPole),
+Nature-CNN "shallow torso" (Pong), IMPALA deep ResNet ((16,32,32) channel
+sections, 2 residual blocks each) for Breakout/Procgen/DMLab. Mirrors the
+analog's `haiku_nets.py:26,57,79,104` decomposition but written Flax-first.
+
+TPU notes: convs/matmuls run on the MXU; `dtype` selects the compute dtype
+(bfloat16 halves HBM traffic and doubles MXU throughput) while parameters
+stay float32. Pixel observations arrive uint8 `[..., H, W, C]` and are
+scaled inside the torso so the host→device transfer stays 1 byte/pixel.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _maybe_rescale_pixels(x: jax.Array, dtype) -> jax.Array:
+    if x.dtype == jnp.uint8:
+        return x.astype(dtype) / 255.0
+    return x.astype(dtype)
+
+
+class MLPTorso(nn.Module):
+    """2-layer MLP for vector observations (CartPole smoke config)."""
+
+    hidden_sizes: Sequence[int] = (64, 64)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = x.reshape(*x.shape[:-1], -1) if x.ndim > 2 else x
+        for size in self.hidden_sizes:
+            x = nn.relu(nn.Dense(size, dtype=self.dtype)(x))
+        return x
+
+
+class AtariShallowTorso(nn.Module):
+    """Nature-CNN: 3 convs + Dense(512) (analog `haiku_nets.py:57-76`)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = _maybe_rescale_pixels(x, self.dtype)
+        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), dtype=self.dtype)(x))
+        x = x.reshape(*x.shape[:-3], -1)
+        return nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs with a skip connection (analog `haiku_nets.py:79-101`)."""
+
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        out = nn.relu(x)
+        out = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(out)
+        out = nn.relu(out)
+        out = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(out)
+        return x + out
+
+
+class AtariDeepTorso(nn.Module):
+    """IMPALA deep ResNet: sections of (conv, maxpool, 2 residual blocks)
+    with (16, 32, 32) channels, then Dense(256) (analog
+    `haiku_nets.py:104-130`; IMPALA paper fig. 3)."""
+
+    channel_sections: Sequence[int] = (16, 32, 32)
+    blocks_per_section: int = 2
+    hidden_size: int = 256
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = _maybe_rescale_pixels(x, self.dtype)
+        for channels in self.channel_sections:
+            x = nn.Conv(channels, (3, 3), dtype=self.dtype)(x)
+            x = nn.max_pool(
+                x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
+            )
+            for _ in range(self.blocks_per_section):
+                x = ResidualBlock(channels, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        return nn.relu(nn.Dense(self.hidden_size, dtype=self.dtype)(x))

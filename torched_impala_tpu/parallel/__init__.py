@@ -1,0 +1,19 @@
+"""Mesh/sharding layer: DP over ICI, model axis reserved (SURVEY.md §3b)."""
+
+from torched_impala_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    state_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "make_mesh",
+    "replicated",
+    "state_sharding",
+]

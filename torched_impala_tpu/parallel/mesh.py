@@ -1,0 +1,59 @@
+"""Device mesh construction and sharding specs for the DP learner.
+
+The TPU-native replacement for the reference's NCCL/DDP data parallelism
+(SURVEY.md §3b): a `jax.sharding.Mesh` with a `data` axis (batch-sharded
+learner, gradient all-reduce over ICI inserted by the XLA partitioner) and a
+`model` axis kept in the mesh shape so tensor-parallel layouts remain
+possible without re-plumbing (size 1 for every IMPALA-scale config).
+
+Nothing here talks to collectives directly — shardings are declared, XLA
+inserts `psum`/`all-gather` where the program needs them (the scaling-book
+recipe: pick a mesh, annotate, let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    num_data: Optional[int] = None,
+    num_model: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, model) mesh over `devices` (default: all local)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        num_data = len(devices) // num_model
+    need = num_data * num_model
+    if need > len(devices):
+        raise ValueError(
+            f"mesh ({num_data}x{num_model}) needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_data, num_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, *, time_major: bool = True) -> NamedSharding:
+    """Sharding for `[T, B, ...]` arrays: batch axis over `data`."""
+    if time_major:
+        return NamedSharding(mesh, P(None, DATA_AXIS))
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for `[B, ...]` recurrent-state leaves: batch over `data`."""
+    return NamedSharding(mesh, P(DATA_AXIS))

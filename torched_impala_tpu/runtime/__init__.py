@@ -1,0 +1,26 @@
+"""Actor-learner runtime: actors, batcher, learner, param publication."""
+
+from torched_impala_tpu.runtime.actor import Actor  # noqa: F401
+from torched_impala_tpu.runtime.learner import (  # noqa: F401
+    Learner,
+    LearnerConfig,
+    stack_trajectories,
+)
+from torched_impala_tpu.runtime.loop import TrainResult, train  # noqa: F401
+from torched_impala_tpu.runtime.param_store import ParamStore  # noqa: F401
+from torched_impala_tpu.runtime.types import (  # noqa: F401
+    QueueClosed,
+    Trajectory,
+)
+
+__all__ = [
+    "Actor",
+    "Learner",
+    "LearnerConfig",
+    "ParamStore",
+    "QueueClosed",
+    "TrainResult",
+    "Trajectory",
+    "stack_trajectories",
+    "train",
+]

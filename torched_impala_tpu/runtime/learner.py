@@ -1,0 +1,332 @@
+"""Learner: batches trajectories, runs the jit-compiled V-trace train step.
+
+The training half of the architecture (SURVEY.md §2 row 2, §4.1/§4.3 call
+stacks), TPU-first:
+
+- actors push `Trajectory`s into a bounded host queue (backpressure, the
+  analog's `learner.py:78-79`);
+- a batcher thread stacks B unrolls into one time-major `[T+1, B, ...]`
+  batch and `jax.device_put`s it into a depth-2 device queue so the H2D DMA
+  of batch k+1 overlaps the train step on batch k (the double-buffered
+  replacement for TPU infeed — `jax.lax.infeed` no longer exists in jax 0.9,
+  SURVEY.md §6 comms);
+- `train_step` is ONE donated, jit-compiled XLA program: unroll re-forward →
+  V-trace → loss → grads → global-norm clip → optimizer update;
+- params are republished to actors with a frame-count version stamp
+  (the analog's `(num_frames, params)`, `learner.py:83,203`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.ops.losses import ImpalaLossConfig, impala_loss
+from torched_impala_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    replicated,
+    state_sharding,
+)
+from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
+
+
+@dataclasses.dataclass(frozen=True)
+class LearnerConfig:
+    batch_size: int = 8
+    unroll_length: int = 20
+    loss: ImpalaLossConfig = ImpalaLossConfig()
+    max_grad_norm: float = 40.0  # IMPALA paper's global-norm clip
+    # Publish host params to actors every N steps (1 = every step).
+    publish_interval: int = 1
+    # Call the logger every N learner steps (materializing device scalars to
+    # floats forces a device sync, so keep this > 1 for throughput runs).
+    log_interval: int = 1
+    # Host trajectory queue capacity (in unrolls); bounds actor lead.
+    queue_capacity: Optional[int] = None
+    # Device-side batch queue depth; 2 = double buffering.
+    device_queue_depth: int = 2
+
+
+def stack_trajectories(trajs: list[Trajectory]) -> Trajectory:
+    """Stack B unrolls into one time-major batch: leaves `[T(+1), B, ...]`;
+    agent_state leaves concatenate on their existing batch axis."""
+    batched = Trajectory(
+        obs=np.stack([t.obs for t in trajs], axis=1),
+        first=np.stack([t.first for t in trajs], axis=1),
+        actions=np.stack([t.actions for t in trajs], axis=1),
+        behaviour_logits=np.stack(
+            [t.behaviour_logits for t in trajs], axis=1
+        ),
+        rewards=np.stack([t.rewards for t in trajs], axis=1),
+        cont=np.stack([t.cont for t in trajs], axis=1),
+        agent_state=jax.tree.map(
+            lambda *xs: np.concatenate(xs, axis=0),
+            *[t.agent_state for t in trajs],
+        )
+        if trajs[0].agent_state != ()
+        else (),
+        actor_id=-1,
+        param_version=min(t.param_version for t in trajs),
+    )
+    return batched
+
+
+class Learner:
+    """Single-device learner. The sharded variant lives in `parallel/`."""
+
+    def __init__(
+        self,
+        *,
+        agent: Agent,
+        optimizer: optax.GradientTransformation,
+        config: LearnerConfig,
+        example_obs: np.ndarray,
+        rng: jax.Array,
+        logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
+        mesh: Optional[Mesh] = None,
+    ) -> None:
+        """`mesh=None` → single-device jit; `mesh=Mesh(..., ('data','model'))`
+        → batch sharded over `data`, params/optimizer replicated, gradient
+        all-reduce inserted by the XLA partitioner over ICI (SURVEY.md §3b
+        DP row). batch_size must divide the data-axis size."""
+        self._agent = agent
+        self._optimizer = optimizer
+        self._config = config
+        self._logger = logger
+        self._mesh = mesh
+        if mesh is not None and config.batch_size % mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by data axis "
+                f"{mesh.shape[DATA_AXIS]}"
+            )
+
+        self._params = agent.init_params(rng, jnp.asarray(example_obs))
+        self._opt_state = optimizer.init(self._params)
+        if mesh is not None:
+            rep = replicated(mesh)
+            self._params = jax.device_put(self._params, rep)
+            self._opt_state = jax.device_put(self._opt_state, rep)
+        self.num_frames = 0
+        self.num_steps = 0
+
+        capacity = config.queue_capacity or config.batch_size * 2
+        self._traj_q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._batch_q: queue.Queue = queue.Queue(
+            maxsize=config.device_queue_depth
+        )
+        self._stop = threading.Event()
+        self._batcher_thread: Optional[threading.Thread] = None
+
+        self.param_store = ParamStore()
+        self._publish()
+
+        if mesh is None:
+            self._train_step = jax.jit(
+                self._train_step_impl, donate_argnums=(0, 1)
+            )
+        else:
+            rep = replicated(mesh)
+            bs = batch_sharding(mesh)
+            ss = state_sharding(mesh)
+            # Prefix pytrees: one sharding covers each whole subtree.
+            self._batch_shardings = (bs, bs, bs, bs, bs, bs, ss)
+            self._train_step = jax.jit(
+                self._train_step_impl,
+                donate_argnums=(0, 1),
+                in_shardings=(rep, rep) + self._batch_shardings,
+                out_shardings=(rep, rep, rep),
+            )
+
+    # ---- the hot loop: one fused XLA program ---------------------------
+
+    def _train_step_impl(
+        self,
+        params,
+        opt_state,
+        obs,
+        first,
+        actions,
+        behaviour_logits,
+        rewards,
+        cont,
+        agent_state,
+    ):
+        cfg = self._config.loss
+
+        def loss_fn(p):
+            net_out, _ = self._agent.unroll(p, obs, first, agent_state)
+            values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
+            discounts = cfg.discount * cont
+            out = impala_loss(
+                target_logits=net_out.policy_logits[:-1],
+                behaviour_logits=behaviour_logits,
+                values=values[:-1],
+                bootstrap_value=values[-1],
+                actions=actions,
+                rewards=rewards,
+                discounts=discounts,
+                config=cfg,
+            )
+            return out.total, out.logs
+
+        (_, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grad_norm = optax.global_norm(grads)
+        if self._config.max_grad_norm is not None:
+            scale = jnp.minimum(
+                1.0, self._config.max_grad_norm / (grad_norm + 1e-8)
+            )
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, opt_state = self._optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        logs = dict(logs)
+        logs["grad_norm_unclipped"] = grad_norm
+        logs["weight_norm"] = optax.global_norm(params)
+        return params, opt_state, logs
+
+    # ---- data plumbing -------------------------------------------------
+
+    def enqueue(self, traj: Trajectory) -> None:
+        """Called by actors; blocks when the learner is behind (backpressure).
+        Raises QueueClosed after `stop()` so blocked actors can exit."""
+        while True:
+            if self._stop.is_set():
+                raise QueueClosed()
+            try:
+                self._traj_q.put(traj, timeout=0.5)
+                return
+            except queue.Full:
+                continue
+
+    def _batcher_loop(self) -> None:
+        B = self._config.batch_size
+        while not self._stop.is_set():
+            trajs: list[Trajectory] = []
+            while len(trajs) < B:
+                if self._stop.is_set():
+                    return
+                try:
+                    trajs.append(self._traj_q.get(timeout=0.5))
+                except queue.Empty:
+                    continue
+            batch = stack_trajectories(trajs)
+            arrays = (
+                batch.obs,
+                batch.first,
+                batch.actions,
+                batch.behaviour_logits,
+                batch.rewards,
+                batch.cont,
+                batch.agent_state,
+            )
+            if self._mesh is None:
+                on_device = jax.device_put(arrays)
+            else:
+                on_device = jax.device_put(arrays, self._batch_shardings)
+            while True:
+                if self._stop.is_set():
+                    return
+                try:
+                    self._batch_q.put(
+                        (on_device, batch.param_version), timeout=0.5
+                    )
+                    break
+                except queue.Full:
+                    continue
+
+    def start(self) -> None:
+        if self._batcher_thread is None:
+            self._batcher_thread = threading.Thread(
+                target=self._batcher_loop, name="batcher", daemon=True
+            )
+            self._batcher_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---- stepping ------------------------------------------------------
+
+    def _publish(self) -> None:
+        host_params = jax.tree.map(np.asarray, self._params)
+        self.param_store.publish(self.num_frames, host_params)
+
+    def step_once(self, timeout: Optional[float] = None) -> Mapping[str, Any]:
+        """Block for one device batch, take one SGD step, publish params.
+
+        Raises queue.Empty on timeout. Returned log values are device scalars
+        (no forced sync); the configured logger receives host floats every
+        `log_interval` steps.
+        """
+        arrays, batch_version = self._batch_q.get(timeout=timeout)
+        self._params, self._opt_state, logs = self._train_step(
+            self._params, self._opt_state, *arrays
+        )
+        T = self._config.unroll_length
+        self.num_frames += T * self._config.batch_size
+        self.num_steps += 1
+        logs = dict(logs)
+        logs["num_frames"] = self.num_frames
+        logs["num_steps"] = self.num_steps
+        logs["param_lag_frames"] = self.num_frames - batch_version
+        if self.num_steps % self._config.publish_interval == 0:
+            self._publish()
+        if (
+            self._logger is not None
+            and self.num_steps % self._config.log_interval == 0
+        ):
+            self._logger(
+                {
+                    k: float(v) if isinstance(v, (jax.Array, np.ndarray)) else v
+                    for k, v in logs.items()
+                }
+            )
+        return logs
+
+    def run(
+        self,
+        max_steps: int,
+        stop_event: Optional[threading.Event] = None,
+        watchdog: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Learner loop: `max_steps` SGD steps, then signal stop.
+
+        `watchdog` is invoked whenever no batch arrives within a second — it
+        should raise if the producers are dead (SURVEY.md §6 failure
+        detection) so a fully-stalled job fails loudly instead of hanging.
+        """
+        self.start()
+        steps_done = 0
+        try:
+            while steps_done < max_steps:
+                if stop_event is not None and stop_event.is_set():
+                    break
+                try:
+                    self.step_once(timeout=1.0)
+                    steps_done += 1
+                except queue.Empty:
+                    if watchdog is not None:
+                        watchdog()
+        finally:
+            self.stop()
+            if stop_event is not None:
+                stop_event.set()
+
+    # ---- introspection -------------------------------------------------
+
+    @property
+    def params(self):
+        return self._params
+
+    @property
+    def opt_state(self):
+        return self._opt_state

@@ -1,0 +1,148 @@
+"""Experiment wiring: N actor threads + one learner (SURVEY.md §4.1).
+
+`train()` is the single-host orchestration entry: build agent + learner,
+spawn actor threads against an env factory, run the learner for a step
+budget, and return learning statistics. The CLI (`run.py`) and the smoke
+tests both drive this function.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+import optax
+
+from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.runtime.actor import Actor
+from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
+
+
+@dataclasses.dataclass
+class TrainResult:
+    episode_returns: list  # (actor_id, return, length) in completion order
+    final_logs: Mapping[str, Any]
+    learner: Learner
+    num_frames: int
+
+
+def train(
+    *,
+    agent: Agent,
+    env_factory: Callable[[int], Any],  # seed -> env (gymnasium API)
+    example_obs: np.ndarray,
+    num_actors: int,
+    learner_config: LearnerConfig,
+    optimizer: optax.GradientTransformation,
+    total_steps: int,
+    seed: int = 0,
+    logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
+    log_every: int = 50,
+    actor_device: Optional[str] = "cpu",
+) -> TrainResult:
+    """Run the actor-learner loop for `total_steps` learner updates.
+
+    `actor_device="cpu"` pins actor inference to a host CPU device when that
+    platform is available (falls back to the default backend otherwise), so
+    env-paced single-step policy calls don't pay per-step dispatch latency to
+    the accelerator the learner owns.
+    """
+    device = None
+    if actor_device is not None:
+        try:
+            device = jax.devices(actor_device)[0]
+        except RuntimeError:
+            device = None  # platform not enabled; use default backend
+
+    episode_returns: collections.deque = collections.deque(maxlen=10_000)
+    returns_lock = threading.Lock()
+
+    def on_episode_return(actor_id: int, ret: float, length: int) -> None:
+        with returns_lock:
+            episode_returns.append((actor_id, ret, length))
+
+    step_logs: dict = {}
+
+    def learner_logger(logs: Mapping[str, Any]) -> None:
+        # Called by the learner every `log_interval` steps with host floats.
+        step_logs.update(logs)
+        if logger is not None:
+            with returns_lock:
+                recent = [r for _, r, _ in list(episode_returns)[-100:]]
+            merged = dict(logs)
+            if recent:
+                merged["episode_return_mean"] = float(np.mean(recent))
+            logger(merged)
+
+    learner = Learner(
+        agent=agent,
+        optimizer=optimizer,
+        config=dataclasses.replace(learner_config, log_interval=log_every),
+        example_obs=example_obs,
+        rng=jax.random.key(seed),
+        logger=learner_logger,
+    )
+
+    stop_event = threading.Event()
+    actors: Sequence[Actor] = [
+        Actor(
+            actor_id=i,
+            env=env_factory(seed + 1000 * (i + 1)),
+            agent=agent,
+            param_store=learner.param_store,
+            enqueue=learner.enqueue,
+            unroll_length=learner_config.unroll_length,
+            seed=seed + 1000 * (i + 1),
+            on_episode_return=on_episode_return,
+            device=device,
+        )
+        for i in range(num_actors)
+    ]
+    threads = [
+        threading.Thread(
+            target=a.run, args=(stop_event,), name=f"actor-{a._id}", daemon=True
+        )
+        for a in actors
+    ]
+    for t in threads:
+        t.start()
+
+    def watchdog() -> None:
+        # Called by the learner when no batch arrives for a second: if every
+        # actor thread is dead, fail loudly instead of hanging forever.
+        if all(not t.is_alive() for t in threads):
+            errors = [a.error for a in actors if a.error is not None]
+            detail = (
+                f"first actor error: {errors[0]!r}"
+                if errors
+                else "no recorded errors"
+            )
+            raise RuntimeError(f"all actor threads are dead; {detail}")
+
+    try:
+        learner.run(total_steps, stop_event, watchdog=watchdog)
+    finally:
+        stop_event.set()
+        learner.stop()
+        # Drain the trajectory queue so actor threads blocked on a full
+        # queue can observe the stop event and exit.
+        try:
+            while True:
+                learner._traj_q.get_nowait()
+        except Exception:
+            pass
+        for t in threads:
+            t.join(timeout=5.0)
+
+    with returns_lock:
+        returns = list(episode_returns)
+    return TrainResult(
+        episode_returns=returns,
+        final_logs=dict(step_logs),
+        learner=learner,
+        num_frames=learner.num_frames,
+    )

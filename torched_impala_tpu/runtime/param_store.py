@@ -1,0 +1,41 @@
+"""Versioned parameter publication from learner to actors.
+
+The TPU-native replacement for the reference's shared-memory param broadcast
+(SURVEY.md §6 distributed-communication row): the learner publishes a host
+snapshot under a lock with a frame-count version stamp (the analog's
+`(num_frames, params)` tuple, `learner.py:83,203`); actors poll. The version
+stamp doubles as the staleness telemetry both for logging and for the
+semantic-race checks in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+
+class ParamStore:
+    """Thread-safe (version, params) cell with blocking first-publish."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._published = threading.Event()
+        self._version = -1
+        self._params: Any = None
+
+    def publish(self, version: int, params: Any) -> None:
+        with self._lock:
+            self._version = version
+            self._params = params
+        self._published.set()
+
+    def get(self, timeout: Optional[float] = None) -> tuple[int, Any]:
+        """Latest (version, params); blocks until the first publish."""
+        if not self._published.wait(timeout=timeout):
+            raise TimeoutError("no params published yet")
+        with self._lock:
+            return self._version, self._params
+
+    @property
+    def version(self) -> int:
+        return self._version

@@ -1,0 +1,48 @@
+"""Trajectory container shared by actors, the batcher, and the learner.
+
+Time-major, one env's unroll. Carries T+1 observations/first-flags so the
+learner can bootstrap from the final step (the analog keeps the last timestep
+for exactly this, `actor.py:52-92,:91`), plus the recurrent state the unroll
+started from (`learner.py:96`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import numpy as np
+
+
+class QueueClosed(Exception):
+    """Raised by enqueue once the learner has shut down; actors exit on it."""
+
+
+class Trajectory(NamedTuple):
+    """One unroll of length T (arrays are numpy on the host side).
+
+    Attributes:
+      obs: `[T+1, ...]` observations; obs[T] is the bootstrap observation.
+      first: bool `[T+1]` episode-start flags aligned with obs (first[t] set
+        iff obs[t] begins an episode; used for LSTM resets).
+      actions: int32 `[T]` actions taken at obs[:T].
+      behaviour_logits: float32 `[T, A]` actor-policy logits at act time.
+      rewards: float32 `[T]` rewards following each action.
+      cont: float32 `[T]` continuation flags (1 - done); the learner
+        multiplies by gamma to get per-step discounts, keeping gamma a
+        learner-side hyper-parameter.
+      agent_state: recurrent state at obs[0] (structure matches the net's
+        initial_state; () for feedforward nets).
+      actor_id: which actor produced this unroll.
+      param_version: frame-count stamp of the params used to act —
+        the actor↔learner staleness telemetry (SURVEY.md §6 race detection).
+    """
+
+    obs: np.ndarray
+    first: np.ndarray
+    actions: np.ndarray
+    behaviour_logits: np.ndarray
+    rewards: np.ndarray
+    cont: np.ndarray
+    agent_state: Any
+    actor_id: int = 0
+    param_version: int = 0
