@@ -200,3 +200,44 @@ def test_cartpole_smoke_learns():
         f"no learning signal: early={early:.1f} late={late:.1f}"
     )
     assert result.num_frames == 250 * 4 * 20
+
+
+def test_batcher_thread_failure_surfaces():
+    """A dead batcher thread must fail the learner loudly, not hang it
+    (code-review finding: watchdog only monitored actor threads)."""
+    T, B = 3, 2
+    agent = _agent()
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(batch_size=B, unroll_length=T),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+    )
+    actor = Actor(
+        actor_id=0,
+        env=ScriptedEnv(episode_len=4),
+        agent=agent,
+        param_store=learner.param_store,
+        enqueue=learner.enqueue,
+        unroll_length=T,
+        seed=0,
+    )
+    good = actor.unroll(learner.param_store.get()[1])
+    bad = good._replace(obs=good.obs[:, :2])  # mismatched obs shape
+    learner.enqueue(good)
+    learner.enqueue(bad)
+    learner.start()
+    deadline = 30.0
+    with pytest.raises(RuntimeError, match="batcher thread died"):
+        import time
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            try:
+                learner.step_once(timeout=0.5)
+            except Exception as e:
+                if isinstance(e, RuntimeError):
+                    raise
+        raise AssertionError("batcher failure never surfaced")
+    learner.stop()

@@ -1,0 +1,158 @@
+"""Loggers and checkpoint/resume (SURVEY.md §3 comps 9-10, §6)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.models import Agent, MLPTorso, ImpalaNet
+from torched_impala_tpu.runtime import Learner, LearnerConfig
+from torched_impala_tpu.utils import (
+    Checkpointer,
+    CSVLogger,
+    JSONLinesLogger,
+    MultiLogger,
+    NullLogger,
+    PrintLogger,
+    TensorBoardLogger,
+    pack_rng,
+    unpack_rng,
+)
+
+
+def test_print_logger_formats_scalars():
+    buf = io.StringIO()
+    lg = PrintLogger(stream=buf)
+    lg({"total_loss": 1.23456, "num_steps": 7})
+    out = buf.getvalue()
+    assert "total_loss=1.235" in out and "num_steps=7" in out
+
+
+def test_csv_logger_roundtrip(tmp_path):
+    path = str(tmp_path / "log.csv")
+    lg = CSVLogger(path)
+    lg.write({"a": 1.0, "b": 2})
+    lg.write({"a": 3.0, "b": 4, "ignored_new_key": 9})
+    lg.close()
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "a,b"
+    assert lines[1] == "1.0,2" and lines[2] == "3.0,4"
+
+
+def test_jsonl_logger(tmp_path):
+    import json
+
+    path = str(tmp_path / "log.jsonl")
+    lg = JSONLinesLogger(path)
+    lg.write({"x": np.float32(2.5)})
+    lg.close()
+    assert json.loads(open(path).read()) == {"x": 2.5}
+
+
+def test_tensorboard_logger_writes_events(tmp_path):
+    lg = TensorBoardLogger(str(tmp_path))
+    lg.write({"total_loss": 1.0, "num_steps": 3})
+    lg.close()
+    assert any(
+        "tfevents" in p.name for p in tmp_path.rglob("*") if p.is_file()
+    )
+
+
+def test_multi_logger_fans_out(tmp_path):
+    buf = io.StringIO()
+    csv_path = str(tmp_path / "m.csv")
+    lg = MultiLogger(PrintLogger(stream=buf), CSVLogger(csv_path), NullLogger())
+    lg({"a": 1})
+    lg.close()
+    assert "a=1" in buf.getvalue()
+    assert open(csv_path).read().startswith("a")
+
+
+def test_rng_pack_unpack_roundtrip():
+    key = jax.random.key(123)
+    data = pack_rng(key)
+    assert not jax.dtypes.issubdtype(data.dtype, jax.dtypes.prng_key)
+    key2 = unpack_rng(data)
+    a = jax.random.uniform(key, (3,))
+    b = jax.random.uniform(key2, (3,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _tiny_learner(seed=0):
+    agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+    return Learner(
+        agent=agent,
+        optimizer=optax.rmsprop(1e-3),
+        config=LearnerConfig(batch_size=2, unroll_length=3),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(seed),
+    )
+
+
+def test_checkpoint_restore_none_when_empty(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    assert ck.restore({"x": jnp.zeros((2,))}) is None
+    ck.close()
+
+
+def test_learner_checkpoint_roundtrip(tmp_path):
+    learner = _tiny_learner(seed=0)
+    # Mutate state so the restore target (fresh learner) differs.
+    learner.num_frames = 600
+    learner.num_steps = 100
+    learner._params = jax.tree.map(lambda p: p + 1.0, learner._params)
+    ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+    assert ck.save(100, learner.get_state())
+    ck.wait()
+    assert ck.latest_step() == 100
+
+    fresh = _tiny_learner(seed=1)
+    restored = ck.restore(fresh.get_state())
+    assert restored is not None
+    fresh.set_state(restored)
+    assert fresh.num_frames == 600 and fresh.num_steps == 100
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        fresh.params,
+        learner.params,
+    )
+    # Resume restored the actor-visible param version (SURVEY.md §6).
+    version, params = fresh.param_store.get()
+    assert version == 600
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        learner.params,
+        params,
+    )
+    ck.close()
+
+
+def test_checkpoint_retention(tmp_path):
+    learner = _tiny_learner()
+    ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+    for step in (1, 2, 3):
+        learner.num_steps = step
+        ck.save(step, learner.get_state())
+    ck.wait()
+    assert ck.all_steps() == [2, 3]
+    ck.close()
+
+
+def test_checkpoint_rng_in_state(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    state = {"rng": jax.random.key(7), "n": 5}
+    ck.save(0, state)
+    ck.wait()
+    restored = ck.restore(state)
+    key = unpack_rng(restored["rng"])
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.uniform(key, (2,))),
+        np.asarray(jax.random.uniform(jax.random.key(7), (2,))),
+    )
+    assert int(restored["n"]) == 5
+    ck.close()

@@ -68,9 +68,11 @@ def make_atari(
         scale_obs=False,
     )
     env = gymnasium.wrappers.FrameStackObservation(env, frame_stack)
-    env = TransposeFrameStack(env)
     if reward_clip:
         env = gymnasium.wrappers.TransformReward(env, np.sign)
+    # Outermost: plain-class transpose (not a gymnasium.Wrapper, so it must
+    # come after every gymnasium wrapper in the stack).
+    env = TransposeFrameStack(env)
     n = env.action_space.n
     return env, n, np.zeros((84, 84, frame_stack), np.uint8)
 

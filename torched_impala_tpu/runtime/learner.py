@@ -99,7 +99,7 @@ class Learner:
         """`mesh=None` → single-device jit; `mesh=Mesh(..., ('data','model'))`
         → batch sharded over `data`, params/optimizer replicated, gradient
         all-reduce inserted by the XLA partitioner over ICI (SURVEY.md §3b
-        DP row). batch_size must divide the data-axis size."""
+        DP row). The data-axis size must divide batch_size."""
         self._agent = agent
         self._optimizer = optimizer
         self._config = config
@@ -127,6 +127,9 @@ class Learner:
         )
         self._stop = threading.Event()
         self._batcher_thread: Optional[threading.Thread] = None
+        # A batcher-thread failure is recorded here and re-raised from the
+        # learner loop so a dead pipeline fails loudly instead of hanging.
+        self.error: Optional[BaseException] = None
 
         self.param_store = ParamStore()
         self._publish()
@@ -209,6 +212,13 @@ class Learner:
                 continue
 
     def _batcher_loop(self) -> None:
+        try:
+            self._batcher_loop_impl()
+        except BaseException as e:  # noqa: BLE001 — surfaced via self.error
+            self.error = e
+            raise
+
+    def _batcher_loop_impl(self) -> None:
         B = self._config.batch_size
         while not self._stop.is_set():
             trajs: list[Trajectory] = []
@@ -267,6 +277,8 @@ class Learner:
         (no forced sync); the configured logger receives host floats every
         `log_interval` steps.
         """
+        if self.error is not None:
+            raise RuntimeError("learner batcher thread died") from self.error
         arrays, batch_version = self._batch_q.get(timeout=timeout)
         self._params, self._opt_state, logs = self._train_step(
             self._params, self._opt_state, *arrays
@@ -320,6 +332,40 @@ class Learner:
             self.stop()
             if stop_event is not None:
                 stop_event.set()
+
+    # ---- checkpoint state ----------------------------------------------
+
+    def get_state(self) -> dict:
+        """Checkpointable learner state (SURVEY.md §6 checkpoint row)."""
+        # Host snapshots, not live device refs: the train step donates the
+        # params/opt_state buffers, so live refs would dangle after the next
+        # step_once ("Array has been deleted").
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "opt_state": jax.tree.map(np.asarray, self._opt_state),
+            "num_frames": np.asarray(self.num_frames, np.int64),
+            "num_steps": np.asarray(self.num_steps, np.int64),
+        }
+
+    def set_state(self, state: Mapping[str, Any]) -> None:
+        """Restore from `get_state()`-shaped tree and republish params so
+        actors immediately see the restored policy at its restored frame
+        count (resume restores the actor-visible param version,
+        SURVEY.md §6)."""
+        params = state["params"]
+        opt_state = state["opt_state"]
+        if self._mesh is not None:
+            rep = replicated(self._mesh)
+            params = jax.device_put(params, rep)
+            opt_state = jax.device_put(opt_state, rep)
+        else:
+            params = jax.device_put(params)
+            opt_state = jax.device_put(opt_state)
+        self._params = params
+        self._opt_state = opt_state
+        self.num_frames = int(state["num_frames"])
+        self.num_steps = int(state["num_steps"])
+        self._publish()
 
     # ---- introspection -------------------------------------------------
 

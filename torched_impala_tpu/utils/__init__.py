@@ -1,0 +1,29 @@
+"""Operational utilities: loggers, checkpoint/resume, profiling."""
+
+from torched_impala_tpu.utils.checkpoint import (
+    Checkpointer,
+    pack_rng,
+    unpack_rng,
+)
+from torched_impala_tpu.utils.loggers import (
+    CSVLogger,
+    JSONLinesLogger,
+    Logger,
+    MultiLogger,
+    NullLogger,
+    PrintLogger,
+    TensorBoardLogger,
+)
+
+__all__ = [
+    "Checkpointer",
+    "pack_rng",
+    "unpack_rng",
+    "CSVLogger",
+    "JSONLinesLogger",
+    "Logger",
+    "MultiLogger",
+    "NullLogger",
+    "PrintLogger",
+    "TensorBoardLogger",
+]

@@ -1,0 +1,115 @@
+"""Checkpoint/resume via orbax (SURVEY.md §3 comp. 10, §6 checkpoint row).
+
+The reference's periodic `torch.save({params, opt_state, frame_count})`
+(reconstructed, SURVEY.md §6) becomes orbax async checkpointing of the full
+learner state `{params, opt_state, num_frames, num_steps, rng}` with
+retention. Resume restores the actor-visible param version too: the learner's
+`set_state` republishes to the `ParamStore` with the restored frame count, so
+actors act on the restored policy immediately (SURVEY.md §6: "resume must
+restore the actor-visible param version").
+
+PRNG keys: typed `jax.random.key` arrays are stored as their uint32
+`key_data` (orbax handles raw arrays; callers re-wrap with
+`jax.random.wrap_key_data` if they need a typed key back).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def pack_rng(rng: jax.Array) -> jax.Array:
+    """Typed PRNG key -> raw uint32 key data (checkpoint-safe)."""
+    if jnp_issubdtype_prng(rng):
+        return jax.random.key_data(rng)
+    return rng
+
+
+def unpack_rng(data: jax.Array) -> jax.Array:
+    """Raw uint32 key data -> typed PRNG key (default threefry impl)."""
+    return jax.random.wrap_key_data(np.asarray(data))
+
+
+def jnp_issubdtype_prng(x: Any) -> bool:
+    try:
+        return jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+class Checkpointer:
+    """Thin wrapper over `ocp.CheckpointManager` for learner-state pytrees.
+
+    State trees must contain only arrays / 0-d numpy scalars (ints are
+    converted on save). Saves are async — call `wait()` before reading the
+    files or exiting the process.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ) -> None:
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    @staticmethod
+    def _normalize(state: Mapping[str, Any]) -> dict:
+        def conv(x):
+            if jnp_issubdtype_prng(x):
+                return jax.random.key_data(x)
+            if isinstance(x, (int, float)):
+                return np.asarray(x)
+            return x
+
+        return jax.tree.map(conv, dict(state))
+
+    def save(self, step: int, state: Mapping[str, Any]) -> bool:
+        """Save if the retention policy wants this step; returns whether it
+        saved (async — see `wait`)."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(self._normalize(state))
+        )
+
+    def restore(
+        self, target: Mapping[str, Any], step: Optional[int] = None
+    ) -> Optional[dict]:
+        """Restore `step` (default: latest) into `target`'s structure.
+
+        `target` may hold live arrays or `jax.ShapeDtypeStruct`s; its
+        structure/shapes/dtypes must match the saved state. Returns None if
+        no checkpoint exists.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                return None
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, self._normalize(target)
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list:
+        return list(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

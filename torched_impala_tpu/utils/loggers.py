@@ -1,0 +1,153 @@
+"""Pluggable metric loggers: write(dict) / close() (SURVEY.md §3 comp. 9).
+
+The analog's logger surface (`util.py:42-59`: objects with `write(dict)` and
+`close()`) generalized: every logger is also *callable* so it can be passed
+directly as the `logger=` callback of `Learner`/`train()`. The learner emits
+the scalar set pinned in SURVEY.md §6 (pg/baseline/entropy/total losses,
+grad/weight norms, num_frames, param_lag_frames) plus
+`episode_return_mean` merged in by the orchestration loop.
+
+Step indexing: loggers pull the step from the metrics' own counters
+(`num_steps`, falling back to `num_frames`, falling back to an internal
+write counter) so callers never thread a step argument through.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import sys
+import time
+from typing import IO, Mapping, Optional, Sequence
+
+
+def _step_of(metrics: Mapping[str, object], fallback: int) -> int:
+    for key in ("num_steps", "num_frames"):
+        v = metrics.get(key)
+        if v is not None:
+            return int(v)  # type: ignore[arg-type]
+    return fallback
+
+
+class Logger:
+    """Base: `write(metrics)` / `close()`; instances are callable."""
+
+    def write(self, metrics: Mapping[str, object]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __call__(self, metrics: Mapping[str, object]) -> None:
+        self.write(metrics)
+
+
+class NullLogger(Logger):
+    def write(self, metrics: Mapping[str, object]) -> None:
+        del metrics
+
+
+class PrintLogger(Logger):
+    """One human-readable line per write (floats to 4 sig figs)."""
+
+    def __init__(self, stream: Optional[IO[str]] = None, prefix: str = ""):
+        self._stream = stream or sys.stderr
+        self._prefix = prefix
+        self._t0 = time.monotonic()
+
+    def write(self, metrics: Mapping[str, object]) -> None:
+        parts = []
+        for k, v in metrics.items():
+            if isinstance(v, float):
+                parts.append(f"{k}={v:.4g}")
+            else:
+                parts.append(f"{k}={v}")
+        elapsed = time.monotonic() - self._t0
+        print(
+            f"{self._prefix}[{elapsed:8.1f}s] " + " ".join(parts),
+            file=self._stream,
+            flush=True,
+        )
+
+
+class CSVLogger(Logger):
+    """Append rows to a CSV file; columns fixed by the first write (later
+    unseen keys are dropped — keep the learner's scalar set stable)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._file: Optional[IO[str]] = None
+        self._writer: Optional[csv.DictWriter] = None
+        self._fields: Sequence[str] = ()
+
+    def write(self, metrics: Mapping[str, object]) -> None:
+        if self._writer is None:
+            self._fields = list(metrics.keys())
+            self._file = open(self._path, "w", newline="")
+            self._writer = csv.DictWriter(
+                self._file, fieldnames=self._fields, extrasaction="ignore"
+            )
+            self._writer.writeheader()
+        row = {k: metrics.get(k, "") for k in self._fields}
+        self._writer.writerow(row)
+        assert self._file is not None
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+            self._writer = None
+
+
+class JSONLinesLogger(Logger):
+    """One JSON object per line — the machine-readable training log."""
+
+    def __init__(self, path: str):
+        self._file: IO[str] = open(path, "a")
+
+    def write(self, metrics: Mapping[str, object]) -> None:
+        self._file.write(json.dumps(dict(metrics), default=float) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class TensorBoardLogger(Logger):
+    """Scalars to TensorBoard via tensorboardX (SURVEY.md §6 metrics row).
+
+    Import is deferred so hosts without tensorboardX can still use the rest
+    of this module.
+    """
+
+    def __init__(self, logdir: str):
+        from tensorboardX import SummaryWriter
+
+        self._writer = SummaryWriter(logdir)
+        self._writes = 0
+
+    def write(self, metrics: Mapping[str, object]) -> None:
+        step = _step_of(metrics, self._writes)
+        self._writes += 1
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)):
+                self._writer.add_scalar(k, v, global_step=step)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class MultiLogger(Logger):
+    """Fan a write out to several loggers."""
+
+    def __init__(self, *loggers: Logger):
+        self._loggers = loggers
+
+    def write(self, metrics: Mapping[str, object]) -> None:
+        for lg in self._loggers:
+            lg.write(metrics)
+
+    def close(self) -> None:
+        for lg in self._loggers:
+            lg.close()
