@@ -62,21 +62,24 @@ def main() -> None:
         jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
         jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
         jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
+        jnp.zeros((B,), jnp.int32),  # task ids (single-task)
         (),
     )
     arrays = jax.device_put(arrays)
 
-    params, opt_state = learner.params, learner.opt_state
+    params, opt_state, pa = learner.params, learner.opt_state, ()
     # Warmup/compile.
-    params, opt_state, logs = learner._train_step(params, opt_state, *arrays)
+    params, opt_state, pa, logs = learner._train_step(
+        params, opt_state, pa, *arrays
+    )
     jax.block_until_ready(logs)
     log(f"bench: compiled, total_loss={float(logs['total_loss']):.3f}")
 
     steps = 30
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_state, logs = learner._train_step(
-            params, opt_state, *arrays
+        params, opt_state, pa, logs = learner._train_step(
+            params, opt_state, pa, *arrays
         )
     jax.block_until_ready(logs)
     dt = time.perf_counter() - t0

@@ -10,6 +10,7 @@ from torched_impala_tpu.envs.factory import (  # noqa: F401
 )
 from torched_impala_tpu.envs.fake import (  # noqa: F401
     FakeAtariEnv,
+    FakeDiscreteEnv,
     ScriptedEnv,
 )
 
@@ -17,6 +18,7 @@ __all__ = [
     "FACTORIES",
     "EnvSpec",
     "FakeAtariEnv",
+    "FakeDiscreteEnv",
     "ScriptedEnv",
     "make_atari",
     "make_cartpole",

@@ -75,3 +75,48 @@ class FakeAtariEnv:
             self._t = 0
         reward = float(self._rng.uniform() < 0.05)
         return self._obs(), reward, terminated, False, {}
+
+
+class FakeDiscreteEnv:
+    """Random vector-obs env with configurable reward scale and task id.
+
+    Stands in for one task of a multi-task suite (DMLab-30-style): each
+    instance carries a `task_id` and a per-task `reward_scale`, so PopArt
+    tests can exercise cross-task normalization without the real emulators.
+    """
+
+    def __init__(
+        self,
+        obs_shape=(8,),
+        num_actions: int = 4,
+        episode_len: int = 10,
+        reward_scale: float = 1.0,
+        task_id: int = 0,
+        seed: int = 0,
+    ):
+        self._rng = np.random.default_rng(seed)
+        self._obs_shape = tuple(obs_shape)
+        self._num_actions = num_actions
+        self._episode_len = episode_len
+        self._reward_scale = reward_scale
+        self.task_id = task_id
+        self._t = 0
+
+    @property
+    def action_space_n(self) -> int:
+        return self._num_actions
+
+    def _obs(self) -> np.ndarray:
+        return self._rng.normal(size=self._obs_shape).astype(np.float32)
+
+    def reset(self, seed=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._t += 1
+        terminated = self._t >= self._episode_len
+        if terminated:
+            self._t = 0
+        reward = float(self._rng.normal()) * self._reward_scale
+        return self._obs(), reward, terminated, False, {}

@@ -20,8 +20,18 @@ from torched_impala_tpu.ops.losses import (  # noqa: F401
     impala_loss,
     policy_gradient_loss,
 )
+from torched_impala_tpu.ops import popart  # noqa: F401  (submodule)
+from torched_impala_tpu.ops.popart import (  # noqa: F401
+    PopArtConfig,
+    PopArtState,
+    popart_impala_loss,
+)
 
 __all__ = [
+    "PopArtConfig",
+    "PopArtState",
+    "popart",
+    "popart_impala_loss",
     "VTraceOutput",
     "importance_ratios",
     "vtrace",

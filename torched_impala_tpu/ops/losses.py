@@ -99,6 +99,35 @@ def entropy_loss(
     return _reduce(-entropy(logits), mask, reduction)
 
 
+def assemble_loss(
+    *,
+    pg: jax.Array,
+    bl: jax.Array,
+    ent: jax.Array,
+    mask: jax.Array,
+    config: ImpalaLossConfig,
+    extra_logs: Mapping[str, jax.Array] | None = None,
+) -> LossOutput:
+    """Combine the three loss components and build the standard log dict.
+
+    Shared by `impala_loss` and `ops.popart.popart_impala_loss` so the
+    weighting and the entropy metric cannot drift between the two.
+    """
+    total = pg + config.vf_coef * bl + config.entropy_coef * ent
+    logs = {
+        "pg_loss": pg,
+        "baseline_loss": bl,
+        "entropy_loss": ent,
+        "total_loss": total,
+        "entropy": -ent / jnp.maximum(jnp.sum(mask), 1.0)
+        if config.reduction == "sum"
+        else -ent,
+    }
+    if extra_logs:
+        logs.update(extra_logs)
+    return LossOutput(total=total, logs=logs)
+
+
 def impala_loss(
     *,
     target_logits: jax.Array,
@@ -154,16 +183,14 @@ def impala_loss(
     # Baseline regresses live values towards the (constant) vs targets.
     bl = baseline_loss(vt.vs - values, mask, config.reduction)
     ent = entropy_loss(target_logits, mask, config.reduction)
-    total = pg + config.vf_coef * bl + config.entropy_coef * ent
-    logs = {
-        "pg_loss": pg,
-        "baseline_loss": bl,
-        "entropy_loss": ent,
-        "total_loss": total,
-        "entropy": -ent / jnp.maximum(jnp.sum(mask), 1.0)
-        if config.reduction == "sum"
-        else -ent,
-        "mean_vtrace_target": jnp.mean(vt.vs),
-        "mean_advantage": jnp.mean(vt.pg_advantages),
-    }
-    return LossOutput(total=total, logs=logs)
+    return assemble_loss(
+        pg=pg,
+        bl=bl,
+        ent=ent,
+        mask=mask,
+        config=config,
+        extra_logs={
+            "mean_vtrace_target": jnp.mean(vt.vs),
+            "mean_advantage": jnp.mean(vt.pg_advantages),
+        },
+    )

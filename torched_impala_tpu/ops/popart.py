@@ -1,0 +1,259 @@
+"""PopArt: adaptive value normalization with output preservation.
+
+Implements the PopArt-IMPALA scheme (van Hasselt et al. 2016; Hessel et al.
+2018 "Multi-task Deep RL with PopArt") the reference's DMLab-30 config uses
+(SURVEY.md §1 item 4, BASELINE.json config 5): the value head predicts
+*normalized* per-task values; running first/second moments of the V-trace
+targets define a per-task affine `(mu, sigma)`; and every statistics update
+rescales the value-head weights so the head's *unnormalized* outputs are
+preserved exactly ("Preserving Outputs Precisely").
+
+Everything here is a pure function over a `PopArtState`, jit-safe, designed
+to close into the learner's single XLA train-step program:
+
+- the per-task EMA update is a scatter-add over task ids (`[B]` int32), so
+  under the DP mesh the cross-shard reduction is an XLA `psum` inserted by
+  the partitioner — no host round-trip;
+- the head rescale is two elementwise ops on the `value_head` kernel/bias.
+
+Loss semantics (matching the PopArt-IMPALA paper):
+- V-trace runs in UNNORMALIZED space (targets must be comparable across a
+  trajectory regardless of when stats moved);
+- the baseline regresses normalized predictions onto normalized targets,
+  both expressed under the POST-update statistics;
+- policy-gradient advantages are divided by sigma, making the actor's
+  gradient scale task-invariant (the whole point for multi-task).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from torched_impala_tpu.ops.losses import (
+    ImpalaLossConfig,
+    LossOutput,
+    action_log_probs,
+    assemble_loss,
+    baseline_loss,
+    entropy_loss,
+    policy_gradient_loss,
+)
+from torched_impala_tpu.ops.vtrace import vtrace as _vtrace
+
+
+@dataclasses.dataclass(frozen=True)
+class PopArtConfig:
+    """Static PopArt hyper-parameters (hashable; safe as a jit static).
+
+    Defaults follow Hessel et al. 2018: step size 3e-4, sigma clipped to
+    [1e-4, 1e6].
+    """
+
+    num_values: int = 1
+    step_size: float = 3e-4
+    sigma_min: float = 1e-4
+    sigma_max: float = 1e6
+
+
+class PopArtState(NamedTuple):
+    """Running per-task moments of the value targets.
+
+    mu: `[num_values]` first moment; nu: `[num_values]` second moment.
+    sigma is derived, not stored: sqrt(nu - mu^2), clipped.
+    """
+
+    mu: jax.Array
+    nu: jax.Array
+
+
+def init(num_values: int) -> PopArtState:
+    """Identity normalization: mu=0, nu=1 => sigma=1."""
+    return PopArtState(
+        mu=jnp.zeros((num_values,), jnp.float32),
+        nu=jnp.ones((num_values,), jnp.float32),
+    )
+
+
+def sigma(state: PopArtState, config: PopArtConfig) -> jax.Array:
+    """Per-task scale `[num_values]`, clipped away from 0 and infinity."""
+    var = state.nu - jnp.square(state.mu)
+    return jnp.clip(jnp.sqrt(jnp.maximum(var, 0.0)),
+                    config.sigma_min, config.sigma_max)
+
+
+def normalize(
+    state: PopArtState, config: PopArtConfig, x: jax.Array, tasks: jax.Array
+) -> jax.Array:
+    """(x - mu[task]) / sigma[task]; `tasks` broadcasts against x."""
+    return (x - state.mu[tasks]) / sigma(state, config)[tasks]
+
+
+def unnormalize(
+    state: PopArtState, config: PopArtConfig, x: jax.Array, tasks: jax.Array
+) -> jax.Array:
+    """sigma[task] * x + mu[task]."""
+    return sigma(state, config)[tasks] * x + state.mu[tasks]
+
+
+def update(
+    state: PopArtState,
+    config: PopArtConfig,
+    targets: jax.Array,  # [T, B] unnormalized value targets (vs)
+    tasks: jax.Array,  # [B] int32 task id per batch element
+    mask: jax.Array,  # [T, B] validity mask
+) -> PopArtState:
+    """One EMA step of (mu, nu) towards the batch's per-task target moments.
+
+    Tasks with no valid samples in the batch keep their statistics. The
+    scatter-add over task ids is the multi-task reduction; XLA turns it into
+    a psum when `tasks`/`targets` are sharded over the data axis.
+    """
+    mask = mask.astype(targets.dtype)
+    per_env_cnt = jnp.sum(mask, axis=0)  # [B]
+    per_env_sum = jnp.sum(targets * mask, axis=0)
+    per_env_sq = jnp.sum(jnp.square(targets) * mask, axis=0)
+
+    n = config.num_values
+    cnt = jnp.zeros((n,), targets.dtype).at[tasks].add(per_env_cnt)
+    tot = jnp.zeros((n,), targets.dtype).at[tasks].add(per_env_sum)
+    tot_sq = jnp.zeros((n,), targets.dtype).at[tasks].add(per_env_sq)
+
+    present = cnt > 0
+    denom = jnp.maximum(cnt, 1.0)
+    batch_mu = tot / denom
+    batch_nu = tot_sq / denom
+
+    b = config.step_size
+    mu = jnp.where(present, state.mu + b * (batch_mu - state.mu), state.mu)
+    nu = jnp.where(present, state.nu + b * (batch_nu - state.nu), state.nu)
+    return PopArtState(mu=mu, nu=nu)
+
+
+def rescale_head(
+    kernel: jax.Array,  # [F, num_values]
+    bias: jax.Array,  # [num_values]
+    old: PopArtState,
+    new: PopArtState,
+    config: PopArtConfig,
+) -> tuple[jax.Array, jax.Array]:
+    """Preserve outputs precisely across a stats update.
+
+    The head emits normalized values n(x) = W f + b with unnormalized
+    reading sigma*n + mu. Choosing W' = W sigma/sigma', b' = (sigma b + mu
+    - mu')/sigma' keeps sigma'*n'(x) + mu' == sigma*n(x) + mu for all x.
+    """
+    s_old = sigma(old, config)
+    s_new = sigma(new, config)
+    kernel = kernel * (s_old / s_new)[None, :]
+    bias = (s_old * bias + old.mu - new.mu) / s_new
+    return kernel, bias
+
+
+def rescale_params(
+    params: Any,
+    old: PopArtState,
+    new: PopArtState,
+    config: PopArtConfig,
+    head_name: str = "value_head",
+) -> Any:
+    """Apply `rescale_head` to the named Dense inside a Flax param tree.
+
+    Relies on the stable "value_head" module name guaranteed by
+    `models/nets.py` (its docstring pins the path for exactly this use).
+    """
+    head = params["params"][head_name]
+    kernel, bias = rescale_head(
+        head["kernel"], head["bias"], old, new, config
+    )
+    new_head = dict(head, kernel=kernel, bias=bias)
+    new_inner = dict(params["params"])
+    new_inner[head_name] = new_head
+    return dict(params, params=new_inner)
+
+
+def popart_impala_loss(
+    *,
+    target_logits: jax.Array,  # [T, B, A]
+    behaviour_logits: jax.Array,  # [T, B, A]
+    norm_values: jax.Array,  # [T, B] normalized V, must carry gradient
+    norm_bootstrap: jax.Array,  # [B] normalized V(x_T)
+    actions: jax.Array,  # [T, B]
+    rewards: jax.Array,  # [T, B]
+    discounts: jax.Array,  # [T, B]
+    tasks: jax.Array,  # [B] int32
+    state: PopArtState,
+    popart_config: PopArtConfig,
+    config: ImpalaLossConfig = ImpalaLossConfig(),
+    mask: jax.Array | None = None,
+) -> tuple[LossOutput, PopArtState]:
+    """IMPALA loss with PopArt normalization; returns the updated stats.
+
+    The caller must, after the optimizer step, apply `rescale_params` with
+    the same (old state, new state) pair so the network's unnormalized
+    outputs stay continuous across the stats move.
+    """
+    if mask is None:
+        mask = jnp.ones_like(rewards)
+    mask = mask.astype(norm_values.dtype)
+
+    s_old = sigma(state, popart_config)[tasks]  # [B]
+    mu_old = state.mu[tasks]
+
+    # V-trace in unnormalized space (stop-grad: targets are constants).
+    values_un = s_old * jax.lax.stop_gradient(norm_values) + mu_old
+    boot_un = s_old * jax.lax.stop_gradient(norm_bootstrap) + mu_old
+    log_rhos = action_log_probs(target_logits, actions) - action_log_probs(
+        behaviour_logits, actions
+    )
+    vt = _vtrace(
+        log_rhos=log_rhos,
+        discounts=discounts,
+        rewards=rewards,
+        values=values_un,
+        bootstrap_value=boot_un,
+        clip_rho_threshold=config.clip_rho_threshold,
+        clip_c_threshold=config.clip_c_threshold,
+        clip_pg_rho_threshold=config.clip_pg_rho_threshold,
+        lambda_=config.lambda_,
+        implementation=config.vtrace_implementation,
+    )
+
+    new_state = jax.lax.stop_gradient(
+        update(state, popart_config, vt.vs, tasks, mask)
+    )
+    s_new = sigma(new_state, popart_config)[tasks]
+    mu_new = new_state.mu[tasks]
+
+    # Live predictions re-expressed under the POST-update statistics — the
+    # same affine correction rescale_params applies to the head weights, so
+    # the regression target and the (future) network agree.
+    norm_values_new = (s_old * norm_values + mu_old - mu_new) / s_new
+    norm_targets = (vt.vs - mu_new) / s_new  # already stop-gradiented
+
+    pg = policy_gradient_loss(
+        target_logits,
+        actions,
+        vt.pg_advantages / s_new,  # scale-invariant actor gradient
+        mask,
+        config.reduction,
+    )
+    bl = baseline_loss(norm_targets - norm_values_new, mask, config.reduction)
+    ent = entropy_loss(target_logits, mask, config.reduction)
+    out = assemble_loss(
+        pg=pg,
+        bl=bl,
+        ent=ent,
+        mask=mask,
+        config=config,
+        extra_logs={
+            "mean_vtrace_target": jnp.mean(vt.vs),
+            "mean_advantage": jnp.mean(vt.pg_advantages),
+            "popart_mu_mean": jnp.mean(new_state.mu),
+            "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
+        },
+    )
+    return out, new_state

@@ -55,14 +55,21 @@ class Actor:
         seed: int = 0,
         on_episode_return: Optional[Callable[[int, float, int], None]] = None,
         device: Optional[jax.Device] = None,
+        task: Optional[int] = None,
     ) -> None:
         """`device` pins the actor's policy step to a specific device —
         typically a host CPU device so env-paced single-step inference never
         competes with (or pays dispatch latency to) the TPU learner. Requires
         the cpu platform to be enabled alongside the TPU one (e.g.
         `jax.config.update("jax_platforms", "tpu,cpu")` before backend init).
-        None = default backend."""
+        None = default backend.
+
+        `task` is the env's task id for multi-task (PopArt) configs; when
+        None it is read from `env.task_id` if present, else 0."""
         self._id = actor_id
+        self._task = int(
+            task if task is not None else getattr(env, "task_id", 0)
+        )
         self._env = env
         self._agent = agent
         self._param_store = param_store
@@ -158,6 +165,7 @@ class Actor:
             agent_state=jax.tree.map(np.asarray, start_state),
             actor_id=self._id,
             param_version=param_version,
+            task=self._task,
         )
 
     def unroll_and_push(self) -> None:

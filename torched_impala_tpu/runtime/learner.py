@@ -30,7 +30,9 @@ import optax
 from jax.sharding import Mesh
 
 from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.ops import popart as popart_ops
 from torched_impala_tpu.ops.losses import ImpalaLossConfig, impala_loss
+from torched_impala_tpu.ops.popart import PopArtConfig
 from torched_impala_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
@@ -56,6 +58,9 @@ class LearnerConfig:
     queue_capacity: Optional[int] = None
     # Device-side batch queue depth; 2 = double buffering.
     device_queue_depth: int = 2
+    # PopArt value normalization (multi-task DMLab-30 config); None = off.
+    # When set, the agent's net must have num_values == popart.num_values.
+    popart: Optional[PopArtConfig] = None
 
 
 def stack_trajectories(trajs: list[Trajectory]) -> Trajectory:
@@ -78,6 +83,7 @@ def stack_trajectories(trajs: list[Trajectory]) -> Trajectory:
         else (),
         actor_id=-1,
         param_version=min(t.param_version for t in trajs),
+        task=np.asarray([t.task for t in trajs], np.int32),
     )
     return batched
 
@@ -110,13 +116,28 @@ class Learner:
                 f"batch_size {config.batch_size} not divisible by data axis "
                 f"{mesh.shape[DATA_AXIS]}"
             )
+        if config.popart is not None:
+            net_nv = agent.net.num_values
+            if net_nv != config.popart.num_values:
+                # Out-of-range columns would be silently clamped/dropped by
+                # the jit-compiled gathers — fail loudly at construction.
+                raise ValueError(
+                    f"PopArt num_values {config.popart.num_values} != net "
+                    f"value-head width {net_nv}; set ImpalaNet(num_values=K)"
+                )
 
         self._params = agent.init_params(rng, jnp.asarray(example_obs))
         self._opt_state = optimizer.init(self._params)
+        self._popart_state = (
+            popart_ops.init(config.popart.num_values)
+            if config.popart is not None
+            else ()
+        )
         if mesh is not None:
             rep = replicated(mesh)
             self._params = jax.device_put(self._params, rep)
             self._opt_state = jax.device_put(self._opt_state, rep)
+            self._popart_state = jax.device_put(self._popart_state, rep)
         self.num_frames = 0
         self.num_steps = 0
 
@@ -136,19 +157,21 @@ class Learner:
 
         if mesh is None:
             self._train_step = jax.jit(
-                self._train_step_impl, donate_argnums=(0, 1)
+                self._train_step_impl, donate_argnums=(0, 1, 2)
             )
         else:
             rep = replicated(mesh)
             bs = batch_sharding(mesh)
             ss = state_sharding(mesh)
             # Prefix pytrees: one sharding covers each whole subtree.
-            self._batch_shardings = (bs, bs, bs, bs, bs, bs, ss)
+            # (obs, first, actions, logits, rewards, cont all [T(+1), B, ...];
+            # tasks and agent_state leaves are [B, ...].)
+            self._batch_shardings = (bs, bs, bs, bs, bs, bs, ss, ss)
             self._train_step = jax.jit(
                 self._train_step_impl,
-                donate_argnums=(0, 1),
-                in_shardings=(rep, rep) + self._batch_shardings,
-                out_shardings=(rep, rep, rep),
+                donate_argnums=(0, 1, 2),
+                in_shardings=(rep, rep, rep) + self._batch_shardings,
+                out_shardings=(rep, rep, rep, rep),
             )
 
     # ---- the hot loop: one fused XLA program ---------------------------
@@ -157,33 +180,58 @@ class Learner:
         self,
         params,
         opt_state,
+        popart_state,
         obs,
         first,
         actions,
         behaviour_logits,
         rewards,
         cont,
+        tasks,
         agent_state,
     ):
         cfg = self._config.loss
+        pa_cfg = self._config.popart
 
         def loss_fn(p):
             net_out, _ = self._agent.unroll(p, obs, first, agent_state)
-            values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
             discounts = cfg.discount * cont
-            out = impala_loss(
+            if pa_cfg is None:
+                values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
+                out = impala_loss(
+                    target_logits=net_out.policy_logits[:-1],
+                    behaviour_logits=behaviour_logits,
+                    values=values[:-1],
+                    bootstrap_value=values[-1],
+                    actions=actions,
+                    rewards=rewards,
+                    discounts=discounts,
+                    config=cfg,
+                )
+                return out.total, (out.logs, popart_state)
+            # PopArt: net emits normalized per-task values [T+1, B, K];
+            # select each env's task column, train in normalized space.
+            norm_values = jnp.take_along_axis(
+                net_out.values, tasks[None, :, None], axis=-1
+            )[..., 0]  # [T+1, B]
+            out, new_pa = popart_ops.popart_impala_loss(
                 target_logits=net_out.policy_logits[:-1],
                 behaviour_logits=behaviour_logits,
-                values=values[:-1],
-                bootstrap_value=values[-1],
+                norm_values=norm_values[:-1],
+                norm_bootstrap=norm_values[-1],
                 actions=actions,
                 rewards=rewards,
                 discounts=discounts,
+                tasks=tasks,
+                state=popart_state,
+                popart_config=pa_cfg,
                 config=cfg,
             )
-            return out.total, out.logs
+            return out.total, (out.logs, new_pa)
 
-        (_, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        (_, (logs, new_popart)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
         grad_norm = optax.global_norm(grads)
         if self._config.max_grad_norm is not None:
             scale = jnp.minimum(
@@ -192,10 +240,16 @@ class Learner:
             grads = jax.tree.map(lambda g: g * scale, grads)
         updates, opt_state = self._optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if pa_cfg is not None:
+            # Preserve outputs precisely across the stats move (the "Art"
+            # half of PopArt): rescale the value head for the new (mu, sigma).
+            params = popart_ops.rescale_params(
+                params, popart_state, new_popart, pa_cfg
+            )
         logs = dict(logs)
         logs["grad_norm_unclipped"] = grad_norm
         logs["weight_norm"] = optax.global_norm(params)
-        return params, opt_state, logs
+        return params, opt_state, new_popart, logs
 
     # ---- data plumbing -------------------------------------------------
 
@@ -230,6 +284,16 @@ class Learner:
                 except queue.Empty:
                     continue
             batch = stack_trajectories(trajs)
+            if self._config.popart is not None:
+                bad = int(batch.task.max(initial=0))
+                if bad >= self._config.popart.num_values or batch.task.min(
+                    initial=0
+                ) < 0:
+                    raise ValueError(
+                        f"actor task ids {sorted(set(batch.task.tolist()))} "
+                        f"out of range for PopArt num_values="
+                        f"{self._config.popart.num_values}"
+                    )
             arrays = (
                 batch.obs,
                 batch.first,
@@ -237,6 +301,7 @@ class Learner:
                 batch.behaviour_logits,
                 batch.rewards,
                 batch.cont,
+                batch.task,
                 batch.agent_state,
             )
             if self._mesh is None:
@@ -280,8 +345,10 @@ class Learner:
         if self.error is not None:
             raise RuntimeError("learner batcher thread died") from self.error
         arrays, batch_version = self._batch_q.get(timeout=timeout)
-        self._params, self._opt_state, logs = self._train_step(
-            self._params, self._opt_state, *arrays
+        self._params, self._opt_state, self._popart_state, logs = (
+            self._train_step(
+                self._params, self._opt_state, self._popart_state, *arrays
+            )
         )
         T = self._config.unroll_length
         self.num_frames += T * self._config.batch_size
@@ -340,12 +407,20 @@ class Learner:
         # Host snapshots, not live device refs: the train step donates the
         # params/opt_state buffers, so live refs would dangle after the next
         # step_once ("Array has been deleted").
-        return {
+        state = {
             "params": jax.tree.map(np.asarray, self._params),
             "opt_state": jax.tree.map(np.asarray, self._opt_state),
             "num_frames": np.asarray(self.num_frames, np.int64),
             "num_steps": np.asarray(self.num_steps, np.int64),
         }
+        # Only present under PopArt: keeps non-PopArt checkpoint trees
+        # identical to pre-PopArt ones (orbax restore requires matching
+        # structures, so an always-present key would break old checkpoints).
+        if self._config.popart is not None:
+            state["popart_state"] = jax.tree.map(
+                np.asarray, self._popart_state
+            )
+        return state
 
     def set_state(self, state: Mapping[str, Any]) -> None:
         """Restore from `get_state()`-shaped tree and republish params so
@@ -354,15 +429,27 @@ class Learner:
         SURVEY.md §6)."""
         params = state["params"]
         opt_state = state["opt_state"]
+        popart_state = state.get("popart_state", self._popart_state)
+        if self._config.popart is not None and popart_state != ():
+            # Checkpoint layers may round-trip the NamedTuple as a plain
+            # (mu, nu) sequence/dict; rebuild the typed state.
+            if not isinstance(popart_state, popart_ops.PopArtState):
+                if isinstance(popart_state, Mapping):
+                    popart_state = popart_ops.PopArtState(**popart_state)
+                else:
+                    popart_state = popart_ops.PopArtState(*popart_state)
         if self._mesh is not None:
             rep = replicated(self._mesh)
             params = jax.device_put(params, rep)
             opt_state = jax.device_put(opt_state, rep)
+            popart_state = jax.device_put(popart_state, rep)
         else:
             params = jax.device_put(params)
             opt_state = jax.device_put(opt_state)
+            popart_state = jax.device_put(popart_state)
         self._params = params
         self._opt_state = opt_state
+        self._popart_state = popart_state
         self.num_frames = int(state["num_frames"])
         self.num_steps = int(state["num_steps"])
         self._publish()
@@ -376,3 +463,7 @@ class Learner:
     @property
     def opt_state(self):
         return self._opt_state
+
+    @property
+    def popart_state(self):
+        return self._popart_state

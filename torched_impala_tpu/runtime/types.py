@@ -35,6 +35,9 @@ class Trajectory(NamedTuple):
       actor_id: which actor produced this unroll.
       param_version: frame-count stamp of the params used to act —
         the actor↔learner staleness telemetry (SURVEY.md §6 race detection).
+      task: int task id of the env that produced the unroll (selects the
+        PopArt value column for multi-task configs; 0 for single-task).
+        Batched trajectories carry an int32 `[B]` array here.
     """
 
     obs: np.ndarray
@@ -46,3 +49,4 @@ class Trajectory(NamedTuple):
     agent_state: Any
     actor_id: int = 0
     param_version: int = 0
+    task: int = 0
