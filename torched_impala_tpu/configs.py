@@ -1,0 +1,279 @@
+"""Experiment presets: one registry entry per BASELINE.json config.
+
+The reference exposes a CLI entry point with flags per experiment
+(SURVEY.md §1 item 7, reconstructed); here each experiment is a typed,
+frozen `ExperimentConfig` (SURVEY.md §6 config row: "typed dataclass
+configs, one registered preset per BASELINE.json:6-12 config") plus pure
+builder functions that turn a config into the framework objects (agent,
+optimizer, env factory, learner config).
+
+Envs whose emulators are absent on a host (ale-py/procgen/dmlab,
+SURVEY.md Appendix B) still have complete presets: the agent/optimizer/
+learner build everywhere, and `make_env_factory(cfg, fake=True)` substitutes
+shape-faithful fakes so throughput and integration runs work on any host.
+
+Hyper-parameter provenance: IMPALA paper (PAPERS.md:5) — RMSProp with
+linear lr anneal to 0 over total frames, entropy 0.01, baseline 0.5,
+global-norm grad clip 40; the analog's CartPole-scale settings for the
+smoke config (run_catch.py:29-36,59).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+import optax
+
+from torched_impala_tpu.models import (
+    Agent,
+    AtariDeepTorso,
+    AtariShallowTorso,
+    ImpalaNet,
+    MLPTorso,
+)
+from torched_impala_tpu.ops.losses import ImpalaLossConfig
+from torched_impala_tpu.ops.popart import PopArtConfig
+from torched_impala_tpu.runtime.learner import LearnerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """Everything needed to reproduce one experiment, statically typed."""
+
+    name: str
+    # Environment.
+    env_family: str  # key into envs.FACTORIES
+    env_id: str = ""
+    obs_shape: tuple = ()  # nominal; used for agent init and fakes
+    obs_dtype: str = "float32"
+    num_actions: int = 2
+    num_tasks: int = 1  # >1 => multi-task (PopArt) preset
+    # Model.
+    model: str = "mlp"  # mlp | shallow_cnn | deep_resnet
+    use_lstm: bool = False
+    lstm_size: int = 256
+    # Scale.
+    num_actors: int = 4
+    unroll_length: int = 20
+    batch_size: int = 8
+    total_env_frames: int = 1_000_000
+    # Optimization.
+    lr: float = 6e-4
+    lr_anneal: bool = True  # linear anneal to 0 over total_env_frames
+    rmsprop_decay: float = 0.99
+    rmsprop_eps: float = 1e-7  # paper uses 0.1 for Atari; analog 1e-7
+    max_grad_norm: float = 40.0
+    # Loss.
+    discount: float = 0.99
+    entropy_coef: float = 0.01
+    vf_coef: float = 0.5
+    # Parallelism: shard the learner batch over this many devices (DP);
+    # 0 = single device. SURVEY.md §3b DP row.
+    dp_devices: int = 0
+    popart_step_size: float = 3e-4
+
+    @property
+    def frames_per_step(self) -> int:
+        return self.unroll_length * self.batch_size
+
+    @property
+    def total_learner_steps(self) -> int:
+        return max(1, self.total_env_frames // self.frames_per_step)
+
+
+def make_agent(cfg: ExperimentConfig) -> Agent:
+    if cfg.model == "mlp":
+        torso = MLPTorso()
+    elif cfg.model == "shallow_cnn":
+        torso = AtariShallowTorso()
+    elif cfg.model == "deep_resnet":
+        torso = AtariDeepTorso()
+    else:
+        raise ValueError(f"unknown model {cfg.model!r}")
+    net = ImpalaNet(
+        num_actions=cfg.num_actions,
+        torso=torso,
+        use_lstm=cfg.use_lstm,
+        lstm_size=cfg.lstm_size,
+        num_values=cfg.num_tasks,
+    )
+    return Agent(net)
+
+
+def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
+    """RMSProp with the paper's linear anneal-to-zero schedule (per learner
+    step; the schedule length is total frames / frames-per-step)."""
+    if cfg.lr_anneal:
+        lr = optax.linear_schedule(
+            init_value=cfg.lr,
+            end_value=0.0,
+            transition_steps=cfg.total_learner_steps,
+        )
+    else:
+        lr = cfg.lr
+    return optax.rmsprop(
+        lr, decay=cfg.rmsprop_decay, eps=cfg.rmsprop_eps
+    )
+
+
+def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
+    return LearnerConfig(
+        batch_size=cfg.batch_size,
+        unroll_length=cfg.unroll_length,
+        loss=ImpalaLossConfig(
+            discount=cfg.discount,
+            vf_coef=cfg.vf_coef,
+            entropy_coef=cfg.entropy_coef,
+        ),
+        max_grad_norm=cfg.max_grad_norm,
+        popart=(
+            PopArtConfig(
+                num_values=cfg.num_tasks, step_size=cfg.popart_step_size
+            )
+            if cfg.num_tasks > 1
+            else None
+        ),
+    )
+
+
+def example_obs(cfg: ExperimentConfig) -> np.ndarray:
+    return np.zeros(cfg.obs_shape, np.dtype(cfg.obs_dtype))
+
+
+def make_env_factory(
+    cfg: ExperimentConfig, *, fake: bool = False
+) -> Callable[[int], object]:
+    """seed -> env. `fake=True` substitutes shape-faithful fakes for env
+    families whose emulators aren't installed (throughput/integration runs
+    on any host). Multi-task presets round-robin tasks over seeds."""
+    if fake:
+        from torched_impala_tpu.envs.fake import (
+            FakeAtariEnv,
+            FakeDiscreteEnv,
+        )
+
+        if cfg.obs_dtype == "uint8":
+            shape = cfg.obs_shape
+
+            class _ShapedPixels(FakeAtariEnv):
+                def _obs(self):
+                    return self._rng.integers(
+                        0, 256, size=shape, dtype=np.uint8
+                    )
+
+            pixel_cls = (
+                FakeAtariEnv if shape == (84, 84, 4) else _ShapedPixels
+            )
+
+            def fake_factory(seed: int):
+                env = pixel_cls(num_actions=cfg.num_actions, seed=seed)
+                env.task_id = seed % max(1, cfg.num_tasks)
+                return env
+
+        else:
+
+            def fake_factory(seed: int):
+                return FakeDiscreteEnv(
+                    obs_shape=cfg.obs_shape,
+                    num_actions=cfg.num_actions,
+                    task_id=seed % max(1, cfg.num_tasks),
+                    seed=seed,
+                )
+
+        return fake_factory
+
+    from torched_impala_tpu.envs import FACTORIES
+
+    family = FACTORIES[cfg.env_family]
+
+    def factory(seed: int):
+        if cfg.env_family == "cartpole":
+            env, _, _ = family(seed=seed)
+        else:
+            env, _, _ = family(cfg.env_id, seed=seed)
+        return env
+
+    return factory
+
+
+# ---- the five BASELINE.json presets ------------------------------------
+
+CARTPOLE = ExperimentConfig(
+    name="cartpole",
+    env_family="cartpole",
+    obs_shape=(4,),
+    num_actions=2,
+    model="mlp",
+    num_actors=4,
+    unroll_length=20,
+    batch_size=8,
+    total_env_frames=200_000,
+    lr=5e-3,
+    lr_anneal=False,
+)
+
+PONG = ExperimentConfig(
+    name="pong",
+    env_family="atari",
+    env_id="PongNoFrameskip-v4",
+    obs_shape=(84, 84, 4),
+    obs_dtype="uint8",
+    num_actions=6,
+    model="shallow_cnn",
+    num_actors=32,
+    unroll_length=20,
+    batch_size=32,
+    total_env_frames=200_000_000,
+)
+
+BREAKOUT = ExperimentConfig(
+    name="breakout",
+    env_family="atari",
+    env_id="BreakoutNoFrameskip-v4",
+    obs_shape=(84, 84, 4),
+    obs_dtype="uint8",
+    num_actions=4,
+    model="deep_resnet",
+    use_lstm=True,
+    num_actors=256,
+    unroll_length=20,
+    batch_size=32,
+    total_env_frames=200_000_000,
+)
+
+PROCGEN = ExperimentConfig(
+    name="procgen",
+    env_family="procgen",
+    env_id="coinrun",
+    obs_shape=(64, 64, 3),
+    obs_dtype="uint8",
+    num_actions=15,
+    model="deep_resnet",
+    num_actors=512,
+    unroll_length=20,
+    batch_size=64,
+    total_env_frames=200_000_000,
+    dp_devices=-1,  # -1 = all available devices (DP learner preset)
+)
+
+DMLAB30 = ExperimentConfig(
+    name="dmlab30",
+    env_family="dmlab",
+    env_id="dmlab30",
+    obs_shape=(72, 96, 3),
+    obs_dtype="uint8",
+    num_actions=15,
+    num_tasks=30,
+    model="deep_resnet",
+    use_lstm=True,
+    num_actors=256,
+    unroll_length=100,
+    batch_size=32,
+    total_env_frames=10_000_000_000,
+)
+
+REGISTRY: dict[str, ExperimentConfig] = {
+    c.name: c for c in (CARTPOLE, PONG, BREAKOUT, PROCGEN, DMLAB30)
+}

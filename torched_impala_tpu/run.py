@@ -1,0 +1,240 @@
+"""CLI entry point: `python -m torched_impala_tpu.run --config <preset>`.
+
+The experiment/CLI layer (SURVEY.md §2 top row): pick a preset from
+`configs.REGISTRY`, apply flag overrides, and run training or greedy
+evaluation. One registry entry exists per BASELINE.json config; presets
+whose emulators are missing on this host run with `--fake-envs`.
+
+Examples:
+  python -m torched_impala_tpu.run --config cartpole
+  python -m torched_impala_tpu.run --config pong --fake-envs --total-steps 50
+  python -m torched_impala_tpu.run --config cartpole --mode eval \
+      --checkpoint-dir /tmp/ck --eval-episodes 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+import jax
+import numpy as np
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True, help="preset name")
+    p.add_argument("--mode", choices=("train", "eval"), default="train")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None,
+                   help="jax platform list, e.g. 'cpu' or 'tpu,cpu' "
+                        "('<accel>,cpu' enables CPU-pinned actor inference; "
+                        "set before any backend is initialized)")
+    # Scale overrides.
+    p.add_argument("--num-actors", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--unroll-length", type=int, default=None)
+    p.add_argument("--total-steps", type=int, default=None,
+                   help="learner updates (default: total_env_frames/T*B)")
+    p.add_argument("--total-env-frames", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    # Parallelism.
+    p.add_argument("--dp", type=int, default=None,
+                   help="shard learner batch over N devices (-1 = all)")
+    # Environments.
+    p.add_argument("--fake-envs", action="store_true",
+                   help="substitute shape-faithful fake envs (no emulators)")
+    # Logging / checkpointing.
+    p.add_argument("--logger", choices=("print", "csv", "tb", "jsonl", "null"),
+                   default="print")
+    p.add_argument("--logdir", default="/tmp/torched_impala_tpu")
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-interval", type=int, default=1000,
+                   help="learner steps between checkpoint saves")
+    p.add_argument("--resume", action="store_true")
+    # Eval.
+    p.add_argument("--eval-episodes", type=int, default=10)
+    p.add_argument("--eval-stochastic", action="store_true",
+                   help="sample actions instead of argmax")
+    # Profiling (SURVEY.md §6 tracing row).
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the learner loop")
+    return p.parse_args(argv)
+
+
+def build_config(args: argparse.Namespace):
+    from torched_impala_tpu.configs import REGISTRY
+
+    if args.config not in REGISTRY:
+        raise SystemExit(
+            f"unknown config {args.config!r}; have {sorted(REGISTRY)}"
+        )
+    cfg = REGISTRY[args.config]
+    overrides = {}
+    for flag, field in (
+        ("num_actors", "num_actors"),
+        ("batch_size", "batch_size"),
+        ("unroll_length", "unroll_length"),
+        ("total_env_frames", "total_env_frames"),
+        ("lr", "lr"),
+        ("dp", "dp_devices"),
+    ):
+        v = getattr(args, flag)
+        if v is not None:
+            overrides[field] = v
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def make_logger(args: argparse.Namespace):
+    from torched_impala_tpu.utils import loggers
+
+    if args.logger == "print":
+        return loggers.PrintLogger()
+    if args.logger == "csv":
+        return loggers.CSVLogger(f"{args.logdir}/{args.config}.csv")
+    if args.logger == "tb":
+        return loggers.TensorBoardLogger(f"{args.logdir}/{args.config}")
+    if args.logger == "jsonl":
+        return loggers.JSONLinesLogger(f"{args.logdir}/{args.config}.jsonl")
+    return loggers.NullLogger()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.parallel import make_mesh
+    from torched_impala_tpu.runtime.loop import train
+    from torched_impala_tpu.utils.checkpoint import Checkpointer
+
+    cfg = build_config(args)
+    agent = configs.make_agent(cfg)
+
+    mesh = None
+    if cfg.dp_devices:  # 0 = single-device; -1 = all devices; N = N devices
+        n = len(jax.devices()) if cfg.dp_devices == -1 else cfg.dp_devices
+        mesh = make_mesh(num_data=n)
+
+    checkpointer = (
+        Checkpointer(args.checkpoint_dir)
+        if args.checkpoint_dir is not None
+        else None
+    )
+
+    if args.mode == "eval":
+        try:
+            return run_eval(args, cfg, agent, checkpointer)
+        finally:
+            if checkpointer is not None:
+                checkpointer.close()
+
+    env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
+    total_steps = (
+        args.total_steps
+        if args.total_steps is not None
+        else cfg.total_learner_steps
+    )
+    logger = make_logger(args)
+    print(
+        f"config={cfg.name} actors={cfg.num_actors} T={cfg.unroll_length} "
+        f"B={cfg.batch_size} steps={total_steps} "
+        f"mesh={None if mesh is None else dict(mesh.shape)} "
+        f"backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+
+    profile_ctx = None
+    if args.profile_dir:
+        profile_ctx = jax.profiler.trace(
+            args.profile_dir, create_perfetto_link=False
+        )
+        profile_ctx.__enter__()
+    try:
+        result = train(
+            agent=agent,
+            env_factory=env_factory,
+            example_obs=configs.example_obs(cfg),
+            num_actors=cfg.num_actors,
+            learner_config=configs.make_learner_config(cfg),
+            optimizer=configs.make_optimizer(cfg),
+            total_steps=total_steps,
+            seed=args.seed,
+            logger=logger,
+            log_every=args.log_every,
+            mesh=mesh,
+            checkpointer=checkpointer,
+            checkpoint_interval=args.checkpoint_interval,
+            resume=args.resume,
+        )
+    finally:
+        if profile_ctx is not None:
+            profile_ctx.__exit__(*sys.exc_info())
+        logger.close()
+        if checkpointer is not None:
+            checkpointer.close()
+
+    recent = [r for _, r, _ in result.episode_returns[-100:]]
+    mean_ret = float(np.mean(recent)) if recent else float("nan")
+    print(
+        f"done: steps={result.learner.num_steps} "
+        f"frames={result.num_frames} episodes={len(result.episode_returns)} "
+        f"recent_return_mean={mean_ret:.2f}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def run_eval(args, cfg, agent, checkpointer) -> int:
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.runtime.evaluator import run_episodes
+
+    params = agent.init_params(
+        jax.random.key(args.seed),
+        jax.numpy.asarray(configs.example_obs(cfg)),
+    )
+    if checkpointer is not None:
+        # Restore just the params subtree from the latest checkpoint.
+        target = {
+            "params": params,
+            "opt_state": configs.make_optimizer(cfg).init(params),
+            "num_frames": np.asarray(0, np.int64),
+            "num_steps": np.asarray(0, np.int64),
+        }
+        if cfg.num_tasks > 1:
+            from torched_impala_tpu.ops import popart as popart_ops
+
+            target["popart_state"] = popart_ops.init(cfg.num_tasks)
+        restored = checkpointer.restore(target)
+        if restored is None:
+            print("no checkpoint found; evaluating fresh params",
+                  file=sys.stderr)
+        else:
+            params = restored["params"]
+            print(
+                f"restored checkpoint @ step {checkpointer.latest_step()}",
+                file=sys.stderr,
+            )
+
+    env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
+    env = env_factory(args.seed + 777_000)
+    result = run_episodes(
+        agent=agent,
+        params=params,
+        env=env,
+        num_episodes=args.eval_episodes,
+        greedy=not args.eval_stochastic,
+        seed=args.seed,
+    )
+    print(
+        f"eval: episodes={len(result.returns)} "
+        f"mean_return={result.mean_return:.2f} "
+        f"mean_length={result.mean_length:.1f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

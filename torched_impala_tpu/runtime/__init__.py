@@ -1,6 +1,10 @@
 """Actor-learner runtime: actors, batcher, learner, param publication."""
 
 from torched_impala_tpu.runtime.actor import Actor  # noqa: F401
+from torched_impala_tpu.runtime.evaluator import (  # noqa: F401
+    EvalResult,
+    run_episodes,
+)
 from torched_impala_tpu.runtime.learner import (  # noqa: F401
     Learner,
     LearnerConfig,
@@ -15,6 +19,8 @@ from torched_impala_tpu.runtime.types import (  # noqa: F401
 
 __all__ = [
     "Actor",
+    "EvalResult",
+    "run_episodes",
     "Learner",
     "LearnerConfig",
     "ParamStore",

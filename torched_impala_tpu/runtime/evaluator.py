@@ -1,0 +1,94 @@
+"""Eval runner: roll the policy greedily, report episode returns.
+
+The reference's `test`/`eval` entry (SURVEY.md §4.5, reconstructed as the
+standard pattern): load checkpointed params, run N episodes with the greedy
+(argmax) policy, report the mean return — the measurement side of the
+"return parity @200M frames" metric (BASELINE.json:2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torched_impala_tpu.models.agent import Agent
+
+
+@dataclasses.dataclass
+class EvalResult:
+    returns: list
+    lengths: list
+
+    @property
+    def mean_return(self) -> float:
+        return float(np.mean(self.returns)) if self.returns else float("nan")
+
+    @property
+    def mean_length(self) -> float:
+        return float(np.mean(self.lengths)) if self.lengths else float("nan")
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_eval_step(agent: Agent, greedy: bool):
+    def _step(params, key, obs, first, state):
+        key, sub = jax.random.split(key)
+        out = agent.step(params, sub, obs, first, state)
+        if greedy:
+            action = jnp.argmax(out.policy_logits, axis=-1).astype(jnp.int32)
+        else:
+            action = out.action
+        return key, action, out.state
+
+    return jax.jit(_step)
+
+
+def run_episodes(
+    *,
+    agent: Agent,
+    params,
+    env,
+    num_episodes: int,
+    greedy: bool = True,
+    seed: int = 0,
+    max_steps_per_episode: Optional[int] = None,
+) -> EvalResult:
+    """Play `num_episodes` full episodes; returns per-episode stats.
+
+    `greedy=True` takes argmax actions (the deterministic eval protocol);
+    `greedy=False` samples from the policy (matches training behaviour).
+    """
+    step_fn = _jitted_eval_step(agent, greedy)
+    key = jax.random.key(seed)
+    returns, lengths = [], []
+    for ep in range(num_episodes):
+        obs, _ = env.reset(seed=seed + ep)
+        state = agent.initial_state(1)
+        first = True
+        ep_return, ep_len = 0.0, 0
+        while True:
+            key, action, state = step_fn(
+                params,
+                key,
+                jnp.asarray(np.asarray(obs))[None],
+                jnp.asarray([first]),
+                state,
+            )
+            obs, reward, terminated, truncated, _ = env.step(int(action[0]))
+            ep_return += float(reward)
+            ep_len += 1
+            first = False
+            if terminated or truncated:
+                break
+            if (
+                max_steps_per_episode is not None
+                and ep_len >= max_steps_per_episode
+            ):
+                break
+        returns.append(ep_return)
+        lengths.append(ep_len)
+    return EvalResult(returns=returns, lengths=lengths)

@@ -151,6 +151,10 @@ class Learner:
         # A batcher-thread failure is recorded here and re-raised from the
         # learner loop so a dead pipeline fails loudly instead of hanging.
         self.error: Optional[BaseException] = None
+        # Called on the learner thread after every SGD step with num_steps —
+        # the supported place for exact-cadence side effects (interval
+        # checkpointing), independent of the log_interval throttle.
+        self.post_step: Optional[Callable[[int], None]] = None
 
         self.param_store = ParamStore()
         self._publish()
@@ -369,6 +373,8 @@ class Learner:
                     for k, v in logs.items()
                 }
             )
+        if self.post_step is not None:
+            self.post_step(self.num_steps)
         return logs
 
     def run(

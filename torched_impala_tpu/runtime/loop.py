@@ -43,13 +43,27 @@ def train(
     logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
     log_every: int = 50,
     actor_device: Optional[str] = "cpu",
+    mesh=None,
+    checkpointer=None,
+    checkpoint_interval: int = 0,
+    resume: bool = False,
 ) -> TrainResult:
-    """Run the actor-learner loop for `total_steps` learner updates.
+    """Run the actor-learner loop until `total_steps` TOTAL learner updates.
+
+    `total_steps` counts from step 0 including any restored progress: a
+    resumed run performs only the remainder, keeping the lr schedule and the
+    frame budget aligned with a single uninterrupted run.
 
     `actor_device="cpu"` pins actor inference to a host CPU device when that
     platform is available (falls back to the default backend otherwise), so
     env-paced single-step policy calls don't pay per-step dispatch latency to
     the accelerator the learner owns.
+
+    `mesh` shards the learner over a device mesh (DP; SURVEY.md §3b).
+    `checkpointer` (a `utils.Checkpointer`) saves learner state every
+    `checkpoint_interval` learner steps and at the end; `resume=True`
+    restores the latest checkpoint before training (restoring the
+    actor-visible param version too, SURVEY.md §6 checkpoint row).
     """
     device = None
     if actor_device is not None:
@@ -85,7 +99,28 @@ def train(
         example_obs=example_obs,
         rng=jax.random.key(seed),
         logger=learner_logger,
+        mesh=mesh,
     )
+    if resume and checkpointer is not None:
+        restored = checkpointer.restore(learner.get_state())
+        if restored is not None:
+            learner.set_state(restored)
+
+    if checkpointer is not None and checkpoint_interval > 0:
+        last_saved = [learner.num_steps]
+
+        def _checkpoint_hook(num_steps: int) -> None:
+            # Runs on the learner thread, so get_state() sees a consistent
+            # (params, opt_state, counters) snapshot.
+            if num_steps - last_saved[0] >= checkpoint_interval:
+                checkpointer.save(num_steps, learner.get_state())
+                last_saved[0] = num_steps
+
+        learner.post_step = _checkpoint_hook
+
+    # `total_steps` is the TOTAL step budget: a resumed run does only the
+    # remainder, so the optax schedule and the frame budget line up.
+    remaining_steps = max(0, total_steps - learner.num_steps)
 
     stop_event = threading.Event()
     actors: Sequence[Actor] = [
@@ -124,7 +159,7 @@ def train(
             raise RuntimeError(f"all actor threads are dead; {detail}")
 
     try:
-        learner.run(total_steps, stop_event, watchdog=watchdog)
+        learner.run(remaining_steps, stop_event, watchdog=watchdog)
     finally:
         stop_event.set()
         learner.stop()
@@ -137,6 +172,10 @@ def train(
             pass
         for t in threads:
             t.join(timeout=5.0)
+
+    if checkpointer is not None:
+        checkpointer.save(learner.num_steps, learner.get_state())
+        checkpointer.wait()
 
     with returns_lock:
         returns = list(episode_returns)
