@@ -1,0 +1,277 @@
+"""Actor supervisor tests: restart-on-crash, chaos liveness, budgets.
+
+SURVEY.md §6 failure detection: "actor supervisor that restarts dead env
+workers" + "a chaos flag that kills random actors in tests to prove
+liveness".
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.envs.fake import CrashingEnv, FakeDiscreteEnv
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.runtime import (
+    Actor,
+    ActorSupervisor,
+    Learner,
+    LearnerConfig,
+)
+from torched_impala_tpu.runtime.loop import train
+
+
+def _small_agent(num_actions=3, obs=(6,)):
+    return Agent(
+        ImpalaNet(num_actions=num_actions, torso=MLPTorso(hidden_sizes=(16,)))
+    )
+
+
+class TestSupervisorUnit:
+    def test_restarts_crashed_actor_and_unrolls_keep_flowing(self):
+        agent = _small_agent()
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-3),
+            config=LearnerConfig(batch_size=2, unroll_length=4),
+            example_obs=np.zeros((6,), np.float32),
+            rng=jax.random.key(0),
+        )
+        stop = threading.Event()
+        spawned = []
+
+        def make_actor(slot):
+            spawned.append(slot)
+            env = CrashingEnv(
+                FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=slot),
+                crash_after=10,  # ~2 unrolls then crash
+            )
+            return Actor(
+                actor_id=slot,
+                env=env,
+                agent=agent,
+                param_store=learner.param_store,
+                enqueue=learner.enqueue,
+                unroll_length=4,
+                seed=slot,
+            )
+
+        sup = ActorSupervisor(
+            make_actor=make_actor,
+            num_actors=2,
+            stop_event=stop,
+            check_interval=0.05,
+            backoff_base=0.01,
+        )
+        sup.start()
+        learner.start()
+        try:
+            for _ in range(4):
+                logs = learner.step_once(timeout=60)
+                assert np.isfinite(float(logs["total_loss"]))
+        finally:
+            stop.set()
+            learner.stop()
+            sup.join()
+        # 4 learner steps x B=2 = 8 unrolls consumed; each actor crashes
+        # every ~2 unrolls, so restarts must have happened.
+        assert sup.restarts >= 1
+        assert len(spawned) == 2 + sup.restarts
+
+    def test_budget_exhaustion_reports_unrecoverable(self):
+        agent = _small_agent()
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-3),
+            config=LearnerConfig(batch_size=1, unroll_length=4),
+            example_obs=np.zeros((6,), np.float32),
+            rng=jax.random.key(0),
+        )
+        stop = threading.Event()
+
+        def make_actor(slot):
+            env = CrashingEnv(
+                FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=slot),
+                crash_after=1,  # dies on the very first step, every time
+            )
+            return Actor(
+                actor_id=slot,
+                env=env,
+                agent=agent,
+                param_store=learner.param_store,
+                enqueue=learner.enqueue,
+                unroll_length=4,
+                seed=slot,
+            )
+
+        sup = ActorSupervisor(
+            make_actor=make_actor,
+            num_actors=1,
+            stop_event=stop,
+            check_interval=0.02,
+            max_restarts_per_actor=2,
+            backoff_base=0.01,
+        )
+        sup.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sup.alive_count() == 0 and not sup.can_recover():
+                break
+            time.sleep(0.05)
+        stop.set()
+        sup.join()
+        assert sup.restarts == 2
+        assert not sup.can_recover()
+        assert "chaos" in repr(sup.errors()[0])
+
+    def test_spawn_failure_does_not_kill_monitor(self):
+        # make_actor raising during a restart must consume the restart and
+        # leave the monitor alive to retry — not hang training forever.
+        agent = _small_agent()
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-3),
+            config=LearnerConfig(batch_size=1, unroll_length=4),
+            example_obs=np.zeros((6,), np.float32),
+            rng=jax.random.key(0),
+        )
+        stop = threading.Event()
+        calls = [0]
+
+        def make_actor(slot):
+            calls[0] += 1
+            if calls[0] == 2:  # the first restart's respawn blows up
+                raise RuntimeError("env re-init failed")
+            crash_after = 6 if calls[0] < 3 else 10_000
+            env = CrashingEnv(
+                FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=slot),
+                crash_after=crash_after,
+            )
+            return Actor(
+                actor_id=slot,
+                env=env,
+                agent=agent,
+                param_store=learner.param_store,
+                enqueue=learner.enqueue,
+                unroll_length=4,
+                seed=slot,
+            )
+
+        sup = ActorSupervisor(
+            make_actor=make_actor,
+            num_actors=1,
+            stop_event=stop,
+            check_interval=0.02,
+            backoff_base=0.01,
+        )
+        sup.start()
+        learner.start()
+        try:
+            # Needs the third spawn (post-failure retry) to produce unrolls.
+            logs = learner.step_once(timeout=60)
+            assert np.isfinite(float(logs["total_loss"]))
+        finally:
+            stop.set()
+            learner.stop()
+            sup.join()
+        assert calls[0] >= 3
+        assert any("re-init" in repr(e) for e in sup.errors())
+
+    def test_clean_exit_is_not_restarted(self):
+        # An actor that finishes max_unrolls exits without error; the
+        # supervisor must leave it alone.
+        agent = _small_agent()
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-3),
+            config=LearnerConfig(batch_size=4, unroll_length=4),
+            example_obs=np.zeros((6,), np.float32),
+            rng=jax.random.key(0),
+        )
+        stop = threading.Event()
+
+        class OneShotActor(Actor):
+            def run(self, stop_event, max_unrolls=None):
+                return super().run(stop_event, max_unrolls=1)
+
+        def make_actor(slot):
+            return OneShotActor(
+                actor_id=slot,
+                env=FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=slot),
+                agent=agent,
+                param_store=learner.param_store,
+                enqueue=learner.enqueue,
+                unroll_length=4,
+                seed=slot,
+            )
+
+        sup = ActorSupervisor(
+            make_actor=make_actor,
+            num_actors=2,
+            stop_event=stop,
+            check_interval=0.02,
+        )
+        sup.start()
+        deadline = time.monotonic() + 20
+        while sup.alive_count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.2)  # give the monitor a chance to (wrongly) restart
+        stop.set()
+        sup.join()
+        assert sup.restarts == 0
+        assert not sup.can_recover()  # dead without error = clean
+
+
+class TestChaosTraining:
+    def test_training_survives_crashing_envs(self):
+        # End-to-end liveness: envs crash regularly, the run still reaches
+        # its step budget and reports the restarts it needed.
+        agent = _small_agent()
+
+        def env_factory(seed):
+            return CrashingEnv(
+                FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=seed),
+                crash_after=25,
+            )
+
+        result = train(
+            agent=agent,
+            env_factory=env_factory,
+            example_obs=np.zeros((6,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(batch_size=2, unroll_length=5),
+            optimizer=optax.sgd(1e-3),
+            total_steps=8,
+            log_every=4,
+        )
+        assert result.learner.num_steps == 8
+        assert result.actor_restarts >= 1
+
+    def test_unrecoverable_fleet_fails_loudly(self):
+        agent = _small_agent()
+
+        def env_factory(seed):
+            return CrashingEnv(
+                FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=seed),
+                crash_after=1,
+            )
+
+        with pytest.raises(RuntimeError, match="unrecoverable"):
+            train(
+                agent=agent,
+                env_factory=env_factory,
+                example_obs=np.zeros((6,), np.float32),
+                num_actors=2,
+                learner_config=LearnerConfig(batch_size=2, unroll_length=5),
+                optimizer=optax.sgd(1e-3),
+                total_steps=4,
+                max_actor_restarts=1,
+            )
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -9,6 +9,7 @@ from torched_impala_tpu.envs.factory import (  # noqa: F401
     make_procgen,
 )
 from torched_impala_tpu.envs.fake import (  # noqa: F401
+    CrashingEnv,
     FakeAtariEnv,
     FakeDiscreteEnv,
     ScriptedEnv,
@@ -16,6 +17,7 @@ from torched_impala_tpu.envs.fake import (  # noqa: F401
 
 __all__ = [
     "FACTORIES",
+    "CrashingEnv",
     "EnvSpec",
     "FakeAtariEnv",
     "FakeDiscreteEnv",

@@ -120,3 +120,33 @@ class FakeDiscreteEnv:
             self._t = 0
         reward = float(self._rng.normal()) * self._reward_scale
         return self._obs(), reward, terminated, False, {}
+
+
+class CrashingEnv:
+    """Wraps another env and raises after `crash_after` total steps.
+
+    Chaos-testing helper (SURVEY.md §6 failure detection): a fleet of these
+    exercises the actor supervisor's restart path — each fresh instance
+    crashes again after its own `crash_after` steps.
+    """
+
+    def __init__(self, inner, crash_after: int):
+        self._inner = inner
+        self._crash_after = crash_after
+        self._steps = 0
+        self.task_id = getattr(inner, "task_id", 0)
+
+    @property
+    def action_space_n(self) -> int:
+        return self._inner.action_space_n
+
+    def reset(self, seed=None):
+        return self._inner.reset(seed=seed)
+
+    def step(self, action):
+        self._steps += 1
+        if self._steps >= self._crash_after:
+            raise RuntimeError(
+                f"chaos: env crashed after {self._steps} steps"
+            )
+        return self._inner.step(action)

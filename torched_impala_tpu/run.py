@@ -45,6 +45,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Environments.
     p.add_argument("--fake-envs", action="store_true",
                    help="substitute shape-faithful fake envs (no emulators)")
+    p.add_argument("--chaos", type=int, default=0, metavar="N",
+                   help="fault injection: crash each actor's env every ~N "
+                        "env steps to exercise supervisor restarts")
+    p.add_argument("--max-actor-restarts", type=int, default=10,
+                   help="per-actor supervisor restart budget")
     # Logging / checkpointing.
     p.add_argument("--logger", choices=("print", "csv", "tb", "jsonl", "null"),
                    default="print")
@@ -132,6 +137,14 @@ def main(argv=None) -> int:
                 checkpointer.close()
 
     env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
+    if args.chaos:
+        from torched_impala_tpu.envs.fake import CrashingEnv
+
+        inner_factory = env_factory
+
+        def env_factory(seed: int):  # noqa: F811 — deliberate wrap
+            return CrashingEnv(inner_factory(seed), crash_after=args.chaos)
+
     total_steps = (
         args.total_steps
         if args.total_steps is not None
@@ -168,6 +181,7 @@ def main(argv=None) -> int:
             checkpointer=checkpointer,
             checkpoint_interval=args.checkpoint_interval,
             resume=args.resume,
+            max_actor_restarts=args.max_actor_restarts,
         )
     finally:
         if profile_ctx is not None:
@@ -181,7 +195,8 @@ def main(argv=None) -> int:
     print(
         f"done: steps={result.learner.num_steps} "
         f"frames={result.num_frames} episodes={len(result.episode_returns)} "
-        f"recent_return_mean={mean_ret:.2f}",
+        f"recent_return_mean={mean_ret:.2f} "
+        f"actor_restarts={result.actor_restarts}",
         file=sys.stderr,
     )
     return 0

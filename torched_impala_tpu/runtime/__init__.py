@@ -12,6 +12,9 @@ from torched_impala_tpu.runtime.learner import (  # noqa: F401
 )
 from torched_impala_tpu.runtime.loop import TrainResult, train  # noqa: F401
 from torched_impala_tpu.runtime.param_store import ParamStore  # noqa: F401
+from torched_impala_tpu.runtime.supervisor import (  # noqa: F401
+    ActorSupervisor,
+)
 from torched_impala_tpu.runtime.types import (  # noqa: F401
     QueueClosed,
     Trajectory,
@@ -19,6 +22,7 @@ from torched_impala_tpu.runtime.types import (  # noqa: F401
 
 __all__ = [
     "Actor",
+    "ActorSupervisor",
     "EvalResult",
     "run_episodes",
     "Learner",

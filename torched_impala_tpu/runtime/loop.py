@@ -10,8 +10,9 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import sys
 import threading
-from typing import Any, Callable, Mapping, Optional, Sequence
+from typing import Any, Callable, Mapping, Optional
 
 import jax
 import numpy as np
@@ -20,6 +21,7 @@ import optax
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.runtime.actor import Actor
 from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
+from torched_impala_tpu.runtime.supervisor import ActorSupervisor
 
 
 @dataclasses.dataclass
@@ -28,6 +30,7 @@ class TrainResult:
     final_logs: Mapping[str, Any]
     learner: Learner
     num_frames: int
+    actor_restarts: int = 0
 
 
 def train(
@@ -47,6 +50,7 @@ def train(
     checkpointer=None,
     checkpoint_interval: int = 0,
     resume: bool = False,
+    max_actor_restarts: Optional[int] = 10,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -83,13 +87,18 @@ def train(
 
     def learner_logger(logs: Mapping[str, Any]) -> None:
         # Called by the learner every `log_interval` steps with host floats.
+        # The ONLY writer to `logger`: loggers are not assumed thread-safe,
+        # and schema-dependent ones (CSV) need a stable key set, so restart
+        # telemetry rides this stream instead of the monitor thread's.
         step_logs.update(logs)
         if logger is not None:
             with returns_lock:
                 recent = [r for _, r, _ in list(episode_returns)[-100:]]
             merged = dict(logs)
-            if recent:
-                merged["episode_return_mean"] = float(np.mean(recent))
+            merged["episode_return_mean"] = (
+                float(np.mean(recent)) if recent else float("nan")
+            )
+            merged["actor_restarts"] = supervisor.restarts
             logger(merged)
 
     learner = Learner(
@@ -123,40 +132,56 @@ def train(
     remaining_steps = max(0, total_steps - learner.num_steps)
 
     stop_event = threading.Event()
-    actors: Sequence[Actor] = [
-        Actor(
-            actor_id=i,
-            env=env_factory(seed + 1000 * (i + 1)),
+
+    def make_actor(slot: int) -> Actor:
+        # Fresh env per (re)spawn: actors are stateless up to the published
+        # params, so restart-after-crash just rebuilds the env.
+        return Actor(
+            actor_id=slot,
+            env=env_factory(seed + 1000 * (slot + 1)),
             agent=agent,
             param_store=learner.param_store,
             enqueue=learner.enqueue,
             unroll_length=learner_config.unroll_length,
-            seed=seed + 1000 * (i + 1),
+            seed=seed + 1000 * (slot + 1),
             on_episode_return=on_episode_return,
             device=device,
         )
-        for i in range(num_actors)
-    ]
-    threads = [
-        threading.Thread(
-            target=a.run, args=(stop_event,), name=f"actor-{a._id}", daemon=True
+
+    def on_restart(slot: int, error: BaseException) -> None:
+        # stderr, not the metrics logger: this runs on the monitor thread.
+        print(
+            f"[supervisor] restarting actor {slot} "
+            f"(restart #{supervisor.restarts}): {error!r}",
+            file=sys.stderr,
+            flush=True,
         )
-        for a in actors
-    ]
-    for t in threads:
-        t.start()
+
+    supervisor = ActorSupervisor(
+        make_actor=make_actor,
+        num_actors=num_actors,
+        stop_event=stop_event,
+        max_restarts_per_actor=max_actor_restarts,
+        on_restart=on_restart,
+    )
+    supervisor.start()
 
     def watchdog() -> None:
-        # Called by the learner when no batch arrives for a second: if every
-        # actor thread is dead, fail loudly instead of hanging forever.
-        if all(not t.is_alive() for t in threads):
-            errors = [a.error for a in actors if a.error is not None]
+        # Called by the learner when no batch arrives for a second. The
+        # supervisor restarts crashed actors; fail loudly only when every
+        # slot is dead AND no restart can ever revive one (budget spent or
+        # clean exits).
+        if supervisor.alive_count() == 0 and not supervisor.can_recover():
+            errors = supervisor.errors()
             detail = (
                 f"first actor error: {errors[0]!r}"
                 if errors
                 else "no recorded errors"
             )
-            raise RuntimeError(f"all actor threads are dead; {detail}")
+            raise RuntimeError(
+                f"all actor threads are dead and unrecoverable "
+                f"({supervisor.restarts} restarts performed); {detail}"
+            )
 
     try:
         learner.run(remaining_steps, stop_event, watchdog=watchdog)
@@ -170,8 +195,7 @@ def train(
                 learner._traj_q.get_nowait()
         except Exception:
             pass
-        for t in threads:
-            t.join(timeout=5.0)
+        supervisor.join()
 
     if checkpointer is not None:
         checkpointer.save(learner.num_steps, learner.get_state())
@@ -184,4 +208,5 @@ def train(
         final_logs=dict(step_logs),
         learner=learner,
         num_frames=learner.num_frames,
+        actor_restarts=supervisor.restarts,
     )

@@ -1,0 +1,177 @@
+"""Actor supervisor: keeps the rollout fleet alive (SURVEY.md §6 failure
+detection row).
+
+The reference has no recovery story — a dead actor silently shrinks the
+producer pool (reconstructed, SURVEY.md §6). Here a supervisor thread
+monitors every actor thread and, when one dies with an error, rebuilds the
+env and spawns a fresh `Actor` in its slot. Actors are stateless up to the
+published params, so a restart is cheap and semantically clean: the new
+actor pulls the current params from the `ParamStore` and resumes producing
+unrolls.
+
+Restarts are rate-limited per slot (a crash-looping env backs off
+exponentially) and capped by `max_restarts_per_actor`; a slot that exhausts
+its budget stays dead. `alive_count()`/`restarts` feed the learner watchdog
+and telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from torched_impala_tpu.runtime.actor import Actor
+
+
+class ActorSupervisor:
+    """Own and babysit `num_actors` actor threads.
+
+    `make_actor(slot)` must return a fresh `Actor` (including a fresh env)
+    for that slot; it is called once at `start()` and again on every
+    restart.
+    """
+
+    def __init__(
+        self,
+        *,
+        make_actor: Callable[[int], Actor],
+        num_actors: int,
+        stop_event: threading.Event,
+        check_interval: float = 0.5,
+        max_restarts_per_actor: Optional[int] = 10,
+        backoff_base: float = 0.5,
+        backoff_max: float = 30.0,
+        on_restart: Optional[Callable[[int, BaseException], None]] = None,
+    ) -> None:
+        self._make_actor = make_actor
+        self._num = num_actors
+        self._stop = stop_event
+        self._interval = check_interval
+        self._max_restarts = max_restarts_per_actor
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        self._on_restart = on_restart
+
+        self.actors: List[Actor] = []
+        self._threads: List[threading.Thread] = []
+        self._restart_counts = [0] * num_actors
+        self._next_restart_at = [0.0] * num_actors
+        self._restarting = [False] * num_actors
+        self._spawn_errors: List[Optional[BaseException]] = (
+            [None] * num_actors
+        )
+        self._monitor: Optional[threading.Thread] = None
+        self.restarts = 0
+        # Guards every slot-state mutation; the learner watchdog reads
+        # alive_count()/can_recover() from another thread, and a restart
+        # must be atomic with respect to those reads (no window where a
+        # slot mid-restart looks dead-and-unrecoverable).
+        self._lock = threading.Lock()
+
+    def _spawn_locked(self, slot: int, actor: Actor) -> None:
+        thread = threading.Thread(
+            target=actor.run,
+            args=(self._stop,),
+            name=f"actor-{slot}",
+            daemon=True,
+        )
+        if slot < len(self.actors):
+            self.actors[slot] = actor
+            self._threads[slot] = thread
+        else:
+            self.actors.append(actor)
+            self._threads.append(thread)
+        thread.start()
+
+    def start(self) -> None:
+        with self._lock:
+            for slot in range(self._num):
+                self._spawn_locked(slot, self._make_actor(slot))
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="actor-supervisor", daemon=True
+        )
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            for slot in range(self._num):
+                self._maybe_restart(slot)
+
+    def _maybe_restart(self, slot: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            thread = self._threads[slot]
+            actor = self.actors[slot]
+            if thread.is_alive():
+                return
+            if actor.error is None:
+                return  # clean exit (max_unrolls/stop), not a crash
+            if (
+                self._max_restarts is not None
+                and self._restart_counts[slot] >= self._max_restarts
+            ):
+                return  # budget exhausted; slot stays dead
+            if now < self._next_restart_at[slot]:
+                return  # backing off
+            error = actor.error
+            self._restarting[slot] = True
+            self._restart_counts[slot] += 1
+            self.restarts += 1
+            backoff = min(
+                self._backoff_max,
+                self._backoff_base * (2 ** (self._restart_counts[slot] - 1)),
+            )
+            self._next_restart_at[slot] = now + backoff
+        # Callbacks and actor construction run OUTSIDE the lock (they do
+        # arbitrary-duration work: logging, env building, env.reset) while
+        # the `restarting` flag keeps can_recover() truthful.
+        try:
+            if self._on_restart is not None:
+                self._on_restart(slot, error)
+            new_actor = self._make_actor(slot)
+        except BaseException as e:  # noqa: BLE001 — must not kill monitor
+            # A failed re-spawn consumes the restart and leaves the old
+            # (errored) actor in place, so the slot is retried after its
+            # backoff — or reported unrecoverable once the budget is spent.
+            with self._lock:
+                self._spawn_errors[slot] = e
+                self._restarting[slot] = False
+            return
+        with self._lock:
+            self._spawn_locked(slot, new_actor)
+            self._restarting[slot] = False
+
+    def alive_count(self) -> int:
+        with self._lock:
+            return sum(t.is_alive() for t in self._threads) + sum(
+                self._restarting
+            )
+
+    def can_recover(self) -> bool:
+        """True if any slot is alive, mid-restart, or dead-with-error and
+        still within its restart budget (i.e. the monitor will revive it)."""
+        with self._lock:
+            for slot in range(self._num):
+                if self._restarting[slot]:
+                    return True
+                if self._threads[slot].is_alive():
+                    return True
+                if self.actors[slot].error is not None and (
+                    self._max_restarts is None
+                    or self._restart_counts[slot] < self._max_restarts
+                ):
+                    return True
+        return False
+
+    def errors(self) -> List[BaseException]:
+        with self._lock:
+            errs = [a.error for a in self.actors if a.error is not None]
+            errs.extend(e for e in self._spawn_errors if e is not None)
+        return errs
+
+    def join(self, timeout_per_thread: float = 5.0) -> None:
+        if self._monitor is not None:
+            self._monitor.join(timeout=self._interval + 1.0)
+        for t in self._threads:
+            t.join(timeout=timeout_per_thread)
