@@ -1,0 +1,205 @@
+"""VectorActor tests: per-env trajectory integrity, LSTM state slicing,
+end-to-end training with batched actor inference.
+
+The vectorized rollout path must emit trajectories indistinguishable (in
+structure and env alignment) from scalar `Actor` output — the learner-side
+contract (tests/test_actor.py shapes) is the oracle.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.envs.fake import FakeDiscreteEnv, ScriptedEnv
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.runtime import (
+    Learner,
+    LearnerConfig,
+    ParamStore,
+    VectorActor,
+)
+from torched_impala_tpu.runtime.loop import train
+
+
+def _agent(num_actions=2, lstm=False):
+    return Agent(
+        ImpalaNet(
+            num_actions=num_actions,
+            torso=MLPTorso(hidden_sizes=(16,)),
+            use_lstm=lstm,
+            lstm_size=8,
+        )
+    )
+
+
+def _store_and_params(agent, obs_shape):
+    params = agent.init_params(
+        jax.random.key(0), jnp.zeros(obs_shape, jnp.float32)
+    )
+    store = ParamStore()
+    store.publish(0, params)
+    return store, params
+
+
+class TestUnroll:
+    def test_shapes_and_env_alignment(self):
+        # 3 scripted envs with different episode lengths: each per-env
+        # trajectory must carry that env's own episode boundary structure.
+        T, E = 6, 3
+        agent = _agent()
+        store, params = _store_and_params(agent, (4,))
+        pushed = []
+        envs = [ScriptedEnv(episode_len=n) for n in (2, 3, 5)]
+        actor = VectorActor(
+            actor_id=0,
+            envs=envs,
+            agent=agent,
+            param_store=store,
+            enqueue=pushed.append,
+            unroll_length=T,
+            seed=0,
+        )
+        actor.unroll_and_push()
+        assert len(pushed) == E
+        assert actor.num_unrolls == E
+        for i, traj in enumerate(pushed):
+            assert traj.obs.shape == (T + 1, 4)
+            assert traj.first.shape == (T + 1,)
+            assert traj.actions.shape == (T,)
+            assert traj.behaviour_logits.shape == (T, 2)
+            assert traj.rewards.shape == (T,)
+            assert traj.cont.shape == (T,)
+            # ScriptedEnv rewards 1 every step.
+            np.testing.assert_array_equal(traj.rewards, np.ones(T))
+            # Episode of length n => cont is 0 at steps n-1, 2n-1, ...
+            n = (2, 3, 5)[i]
+            expected_cont = np.array(
+                [0.0 if (t + 1) % n == 0 else 1.0 for t in range(T)],
+                np.float32,
+            )
+            np.testing.assert_array_equal(traj.cont, expected_cont)
+            # first[t+1] mirrors done[t]; first[0] is the initial reset.
+            assert traj.first[0]
+            np.testing.assert_array_equal(
+                traj.first[1:], expected_cont == 0.0
+            )
+
+    def test_lstm_state_sliced_per_env(self):
+        T, E = 4, 3
+        agent = _agent(lstm=True)
+        store, _ = _store_and_params(agent, (4,))
+        pushed = []
+        actor = VectorActor(
+            actor_id=0,
+            envs=[ScriptedEnv(episode_len=3) for _ in range(E)],
+            agent=agent,
+            param_store=store,
+            enqueue=pushed.append,
+            unroll_length=T,
+            seed=0,
+        )
+        actor.unroll_and_push()
+        actor.unroll_and_push()  # second cycle: carry is non-zero now
+        assert len(pushed) == 2 * E
+        for traj in pushed:
+            for leaf in jax.tree.leaves(traj.agent_state):
+                assert leaf.shape == (1, 8)
+        # Second-cycle trajectories start from the carried (nonzero) state.
+        second = pushed[E:]
+        assert any(
+            np.any(np.asarray(leaf) != 0)
+            for t in second
+            for leaf in jax.tree.leaves(t.agent_state)
+        )
+
+    def test_task_ids_preserved(self):
+        agent = _agent(num_actions=3)
+        store, _ = _store_and_params(agent, (6,))
+        pushed = []
+        envs = [
+            FakeDiscreteEnv(obs_shape=(6,), num_actions=3, task_id=i, seed=i)
+            for i in range(3)
+        ]
+        actor = VectorActor(
+            actor_id=0,
+            envs=envs,
+            agent=agent,
+            param_store=store,
+            enqueue=pushed.append,
+            unroll_length=3,
+            seed=0,
+        )
+        actor.unroll_and_push()
+        assert [t.task for t in pushed] == [0, 1, 2]
+
+    def test_episode_returns_per_env(self):
+        agent = _agent()
+        store, _ = _store_and_params(agent, (4,))
+        returns = []
+        actor = VectorActor(
+            actor_id=7,
+            envs=[ScriptedEnv(episode_len=2), ScriptedEnv(episode_len=3)],
+            agent=agent,
+            param_store=store,
+            enqueue=lambda t: None,
+            unroll_length=6,
+            seed=0,
+            on_episode_return=lambda aid, r, ln: returns.append((aid, r, ln)),
+        )
+        actor.unroll_and_push()
+        # env0: 3 episodes of return 2; env1: 2 episodes of return 3.
+        assert sorted(returns) == [(7, 2.0, 2)] * 3 + [(7, 3.0, 3)] * 2
+
+
+class TestEndToEnd:
+    def test_train_with_vector_actors_learns_shapes(self):
+        agent = _agent(num_actions=3, lstm=True)
+        result = train(
+            agent=agent,
+            env_factory=lambda seed: FakeDiscreteEnv(
+                obs_shape=(4,), num_actions=3, episode_len=7, seed=seed
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            envs_per_actor=3,
+            learner_config=LearnerConfig(batch_size=6, unroll_length=4),
+            optimizer=optax.sgd(1e-3),
+            total_steps=3,
+            log_every=1,
+        )
+        assert result.learner.num_steps == 3
+        assert np.isfinite(result.final_logs["total_loss"])
+        # 3 steps x B=6 unrolls consumed; with 2x3 envs the fleet produced
+        # at least that many.
+        assert result.num_frames == 3 * 6 * 4
+
+    def test_supervisor_restarts_vector_actor(self):
+        from torched_impala_tpu.envs.fake import CrashingEnv
+
+        agent = _agent(num_actions=3)
+        result = train(
+            agent=agent,
+            env_factory=lambda seed: CrashingEnv(
+                FakeDiscreteEnv(
+                    obs_shape=(4,), num_actions=3, episode_len=7, seed=seed
+                ),
+                crash_after=30,
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            envs_per_actor=2,
+            learner_config=LearnerConfig(batch_size=4, unroll_length=5),
+            optimizer=optax.sgd(1e-3),
+            total_steps=5,
+            log_every=5,
+        )
+        assert result.learner.num_steps == 5
+        assert result.actor_restarts >= 1
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -54,8 +54,10 @@ class ExperimentConfig:
     model: str = "mlp"  # mlp | shallow_cnn | deep_resnet
     use_lstm: bool = False
     lstm_size: int = 256
-    # Scale.
+    # Scale. `num_actors` is actor *threads*; each steps `envs_per_actor`
+    # envs with one batched policy dispatch per timestep (VectorActor).
     num_actors: int = 4
+    envs_per_actor: int = 1
     unroll_length: int = 20
     batch_size: int = 8
     total_env_frames: int = 1_000_000

@@ -33,6 +33,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "set before any backend is initialized)")
     # Scale overrides.
     p.add_argument("--num-actors", type=int, default=None)
+    p.add_argument("--envs-per-actor", type=int, default=None,
+                   help="envs stepped per actor thread with one batched "
+                        "policy dispatch per timestep")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--unroll-length", type=int, default=None)
     p.add_argument("--total-steps", type=int, default=None,
@@ -80,6 +83,7 @@ def build_config(args: argparse.Namespace):
     overrides = {}
     for flag, field in (
         ("num_actors", "num_actors"),
+        ("envs_per_actor", "envs_per_actor"),
         ("batch_size", "batch_size"),
         ("unroll_length", "unroll_length"),
         ("total_env_frames", "total_env_frames"),
@@ -182,6 +186,7 @@ def main(argv=None) -> int:
             checkpoint_interval=args.checkpoint_interval,
             resume=args.resume,
             max_actor_restarts=args.max_actor_restarts,
+            envs_per_actor=cfg.envs_per_actor,
         )
     finally:
         if profile_ctx is not None:

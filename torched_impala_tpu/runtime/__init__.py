@@ -19,6 +19,7 @@ from torched_impala_tpu.runtime.types import (  # noqa: F401
     QueueClosed,
     Trajectory,
 )
+from torched_impala_tpu.runtime.vector_actor import VectorActor  # noqa: F401
 
 __all__ = [
     "Actor",
@@ -31,6 +32,7 @@ __all__ = [
     "QueueClosed",
     "TrainResult",
     "Trajectory",
+    "VectorActor",
     "stack_trajectories",
     "train",
 ]

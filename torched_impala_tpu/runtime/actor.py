@@ -5,39 +5,30 @@ The rollout worker of the actor-learner architecture (SURVEY.md §2 row 1,
 `unroll_length` steps with a jitted single-step policy, and push a
 `Trajectory` into the learner's bounded queue (backpressure included).
 
-Host-side by design — env stepping is Python/C on CPU; the policy step is one
-jit dispatch per env step (rng split fused into the same program). The
-trajectory keeps T+1 observations; the final observation is carried over as
-the first observation of the next unroll (the analog's `self._traj[-1:]`
-trick, `actor.py:91`).
+The trajectory keeps T+1 observations; the final observation is carried
+over as the first observation of the next unroll (the analog's
+`self._traj[-1:]` trick, `actor.py:91`).
+
+This is the E=1 facade over `VectorActor` — all rollout semantics
+(episode accounting, truncation-as-termination, LSTM carry, device
+pinning) live in ONE implementation; this class only unwraps the
+batch-of-one trajectories.
 """
 
 from __future__ import annotations
 
-import functools
 import threading
 from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.runtime.param_store import ParamStore
-from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
-
-
-@functools.lru_cache(maxsize=None)
-def _jitted_actor_step(agent: Agent):
-    """One shared jitted step per Agent — N actors of the same agent reuse
-    one traced/compiled program instead of compiling N identical ones."""
-
-    def _step(params, key, obs, first, state):
-        key, sub = jax.random.split(key)
-        out = agent.step(params, sub, obs, first, state)
-        return key, out
-
-    return jax.jit(_step)
+from torched_impala_tpu.runtime.types import Trajectory
+from torched_impala_tpu.runtime.vector_actor import (  # noqa: F401
+    VectorActor,
+    _jitted_actor_step,  # re-export: historical import location
+)
 
 
 class Actor:
@@ -59,120 +50,48 @@ class Actor:
     ) -> None:
         """`device` pins the actor's policy step to a specific device —
         typically a host CPU device so env-paced single-step inference never
-        competes with (or pays dispatch latency to) the TPU learner. Requires
-        the cpu platform to be enabled alongside the TPU one (e.g.
-        `jax.config.update("jax_platforms", "tpu,cpu")` before backend init).
-        None = default backend.
+        competes with (or pays dispatch latency to) the TPU learner; pinning
+        works through committed inputs (params and the rng key are
+        device_put onto `device`, so the jit runs there — jit's own
+        `device=` argument is deprecated in jax 0.9). Requires the cpu
+        platform enabled alongside the accelerator (e.g.
+        `jax.config.update("jax_platforms", "tpu,cpu")` before backend
+        init). None = default backend.
 
         `task` is the env's task id for multi-task (PopArt) configs; when
         None it is read from `env.task_id` if present, else 0."""
-        self._id = actor_id
-        self._task = int(
-            task if task is not None else getattr(env, "task_id", 0)
+        self._inner = VectorActor(
+            actor_id=actor_id,
+            envs=[env],
+            agent=agent,
+            param_store=param_store,
+            enqueue=enqueue,
+            unroll_length=unroll_length,
+            seed=seed,
+            on_episode_return=on_episode_return,
+            device=device,
+            tasks=None if task is None else [task],
         )
-        self._env = env
-        self._agent = agent
-        self._param_store = param_store
-        self._enqueue = enqueue
-        self._unroll_length = unroll_length
-        self._on_episode_return = on_episode_return
 
-        # Device pinning works through committed inputs: params and the rng
-        # key are device_put onto `device`, so the jit runs there
-        # (jit's own `device=` argument is deprecated in jax 0.9).
-        self._step_fn = _jitted_actor_step(agent)
-        self._device = device
-        self._key = jax.random.key(seed)
-        if device is not None:
-            self._key = jax.device_put(self._key, device)
-        self.error: Optional[BaseException] = None
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._inner.error
 
-        obs, _ = env.reset(seed=seed)
-        self._obs = np.asarray(obs)
-        self._first = True
-        self._state = agent.initial_state(1)
-        self._episode_return = 0.0
-        self._episode_len = 0
-        self.num_unrolls = 0
+    @error.setter
+    def error(self, value: Optional[BaseException]) -> None:
+        self._inner.error = value
+
+    @property
+    def num_unrolls(self) -> int:
+        return self._inner.num_unrolls
 
     def unroll(self, params, param_version: int = 0) -> Trajectory:
-        """Produce one T-step trajectory, stepping the env T times.
-
-        `param_version` must be the version returned alongside `params` by
-        the store — stamping it here (not re-reading the store afterwards)
-        keeps the staleness telemetry honest when the learner republishes
-        mid-unroll.
-        """
-        T = self._unroll_length
-        if self._device is not None:
-            params = jax.device_put(params, self._device)
-        obs_buf = np.empty((T + 1, *self._obs.shape), self._obs.dtype)
-        first_buf = np.empty((T + 1,), np.bool_)
-        actions = np.empty((T,), np.int32)
-        rewards = np.empty((T,), np.float32)
-        cont = np.empty((T,), np.float32)
-        logits_buf = None
-        start_state = self._state
-
-        for t in range(T):
-            obs_buf[t] = self._obs
-            first_buf[t] = self._first
-            self._key, out = self._step_fn(
-                params,
-                self._key,
-                jnp.asarray(self._obs)[None],
-                jnp.asarray([self._first]),
-                self._state,
-            )
-            self._state = out.state
-            action = int(out.action[0])
-            if logits_buf is None:
-                logits_buf = np.empty(
-                    (T, out.policy_logits.shape[-1]), np.float32
-                )
-            logits_buf[t] = np.asarray(out.policy_logits[0])
-
-            next_obs, reward, terminated, truncated, _ = self._env.step(action)
-            done = bool(terminated or truncated)
-            actions[t] = action
-            rewards[t] = float(reward)
-            # Truncation is treated as termination (standard for these
-            # frameworks; CartPole's 500-step cap etc.).
-            cont[t] = 0.0 if done else 1.0
-            self._episode_return += float(reward)
-            self._episode_len += 1
-
-            if done:
-                if self._on_episode_return is not None:
-                    self._on_episode_return(
-                        self._id, self._episode_return, self._episode_len
-                    )
-                self._episode_return = 0.0
-                self._episode_len = 0
-                next_obs, _ = self._env.reset()
-            self._obs = np.asarray(next_obs)
-            self._first = done
-
-        obs_buf[T] = self._obs
-        first_buf[T] = self._first
-        return Trajectory(
-            obs=obs_buf,
-            first=first_buf,
-            actions=actions,
-            behaviour_logits=logits_buf,
-            rewards=rewards,
-            cont=cont,
-            agent_state=jax.tree.map(np.asarray, start_state),
-            actor_id=self._id,
-            param_version=param_version,
-            task=self._task,
-        )
+        """Produce one T-step trajectory, stepping the env T times."""
+        (traj,) = self._inner.unroll(params, param_version)
+        return traj
 
     def unroll_and_push(self) -> None:
-        version, params = self._param_store.get()
-        traj = self.unroll(params, version)
-        self._enqueue(traj)
-        self.num_unrolls += 1
+        self._inner.unroll_and_push()
 
     def run(
         self,
@@ -181,16 +100,6 @@ class Actor:
     ) -> None:
         """Actor loop: pull params → unroll → push, until stopped.
 
-        Exceptions are recorded in `self.error` (for the learner watchdog)
-        before propagating out of the thread."""
-        try:
-            while not stop_event.is_set():
-                if max_unrolls is not None and self.num_unrolls >= max_unrolls:
-                    return
-                try:
-                    self.unroll_and_push()
-                except QueueClosed:
-                    return
-        except BaseException as e:  # noqa: BLE001 — watchdog needs any error
-            self.error = e
-            raise
+        Exceptions are recorded in `self.error` (for the learner watchdog
+        and supervisor) before propagating out of the thread."""
+        self._inner.run(stop_event, max_unrolls=max_unrolls)

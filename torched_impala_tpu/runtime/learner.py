@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Callable, Mapping, Optional
 
 import jax
@@ -155,6 +156,12 @@ class Learner:
         # the supported place for exact-cadence side effects (interval
         # checkpointing), independent of the log_interval throttle.
         self.post_step: Optional[Callable[[int], None]] = None
+        # Throughput telemetry (SURVEY.md §6 tracing: infeed starvation vs
+        # compute is THE diagnostic; frames/sec/chip is the north-star
+        # metric BASELINE.json:2).
+        self._wait_accum = 0.0
+        self._last_log_t: Optional[float] = None
+        self._last_log_frames = 0
 
         self.param_store = ParamStore()
         self._publish()
@@ -348,7 +355,14 @@ class Learner:
         """
         if self.error is not None:
             raise RuntimeError("learner batcher thread died") from self.error
-        arrays, batch_version = self._batch_q.get(timeout=timeout)
+        t0 = time.monotonic()
+        try:
+            arrays, batch_version = self._batch_q.get(timeout=timeout)
+        finally:
+            # Count timed-out waits too (queue.Empty propagates to the run
+            # loop): starvation time must not vanish from the diagnostic
+            # exactly when starvation is worst.
+            self._wait_accum += time.monotonic() - t0
         self._params, self._opt_state, self._popart_state, logs = (
             self._train_step(
                 self._params, self._opt_state, self._popart_state, *arrays
@@ -367,6 +381,26 @@ class Learner:
             self._logger is not None
             and self.num_steps % self._config.log_interval == 0
         ):
+            now = time.monotonic()
+            if self._last_log_t is not None:
+                elapsed = max(now - self._last_log_t, 1e-9)
+                # frames/sec of the learner pipeline, and the fraction of
+                # wall time spent starved waiting for a batch: ~0 means the
+                # TPU is the bottleneck, ~1 means actors/H2D are.
+                logs["frames_per_sec"] = (
+                    self.num_frames - self._last_log_frames
+                ) / elapsed
+                logs["batch_wait_frac"] = min(
+                    self._wait_accum / elapsed, 1.0
+                )
+            else:
+                # Keys must exist on the first write too (CSV columns are
+                # fixed by the first row).
+                logs["frames_per_sec"] = float("nan")
+                logs["batch_wait_frac"] = float("nan")
+            self._last_log_t = now
+            self._last_log_frames = self.num_frames
+            self._wait_accum = 0.0
             self._logger(
                 {
                     k: float(v) if isinstance(v, (jax.Array, np.ndarray)) else v

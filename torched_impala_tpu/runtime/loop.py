@@ -22,6 +22,7 @@ from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.runtime.actor import Actor
 from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
 from torched_impala_tpu.runtime.supervisor import ActorSupervisor
+from torched_impala_tpu.runtime.vector_actor import VectorActor
 
 
 @dataclasses.dataclass
@@ -51,6 +52,7 @@ def train(
     checkpoint_interval: int = 0,
     resume: bool = False,
     max_actor_restarts: Optional[int] = 10,
+    envs_per_actor: int = 1,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -133,20 +135,28 @@ def train(
 
     stop_event = threading.Event()
 
-    def make_actor(slot: int) -> Actor:
-        # Fresh env per (re)spawn: actors are stateless up to the published
-        # params, so restart-after-crash just rebuilds the env.
-        return Actor(
+    def make_actor(slot: int):
+        # Fresh env(s) per (re)spawn: actors are stateless up to the
+        # published params, so restart-after-crash just rebuilds the envs.
+        base_seed = seed + 1000 * (slot + 1)
+        common = dict(
             actor_id=slot,
-            env=env_factory(seed + 1000 * (slot + 1)),
             agent=agent,
             param_store=learner.param_store,
             enqueue=learner.enqueue,
             unroll_length=learner_config.unroll_length,
-            seed=seed + 1000 * (slot + 1),
+            seed=base_seed,
             on_episode_return=on_episode_return,
             device=device,
         )
+        if envs_per_actor > 1:
+            return VectorActor(
+                envs=[
+                    env_factory(base_seed + j) for j in range(envs_per_actor)
+                ],
+                **common,
+            )
+        return Actor(env=env_factory(base_seed), **common)
 
     def on_restart(slot: int, error: BaseException) -> None:
         # stderr, not the metrics logger: this runs on the monitor thread.

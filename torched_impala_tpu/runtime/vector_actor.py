@@ -1,0 +1,211 @@
+"""VectorActor: many envs, ONE batched policy dispatch per timestep.
+
+The throughput-critical actor variant (SURVEY.md §8 hard part 1: "plan for
+vectorized envs per actor process"). A plain `Actor` pays one jit dispatch
+per env step; at reference scale (32-512 actors, BASELINE.json:7-10) that
+dispatch overhead dominates. `VectorActor` steps E envs in lockstep and
+batches their policy evaluation into a single `[E, ...]` jit call — host
+Python only loops over envs for the (unavoidable) emulator `step()` calls.
+
+Each unroll cycle emits E independent `Trajectory`s (one per env), so the
+learner-side batcher and all staleness semantics are unchanged: a batch of
+B unrolls may now come from B/E vector actors instead of B scalar ones.
+
+The LSTM carry rides as one `[E, ...]` state; episode boundaries reset it
+per-row inside the net via the `first` flags (models/nets.py reset-core
+semantics), exactly as in the scalar actor.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_actor_step(agent: Agent):
+    """One shared jitted step per Agent — N actors of the same agent reuse
+    one traced/compiled program instead of compiling N identical ones."""
+
+    def _step(params, key, obs, first, state):
+        key, sub = jax.random.split(key)
+        out = agent.step(params, sub, obs, first, state)
+        return key, out
+
+    return jax.jit(_step)
+
+
+class VectorActor:
+    """E envs stepped in lockstep with batched policy inference.
+
+    Presents the same surface as `Actor` (`run`, `unroll_and_push`,
+    `error`, `num_unrolls`) so the supervisor and train loop treat both
+    uniformly.
+    """
+
+    def __init__(
+        self,
+        *,
+        actor_id: int,
+        envs: Sequence,
+        agent: Agent,
+        param_store: ParamStore,
+        enqueue: Callable[[Trajectory], None],
+        unroll_length: int,
+        seed: int = 0,
+        on_episode_return: Optional[Callable[[int, float, int], None]] = None,
+        device: Optional[jax.Device] = None,
+        tasks: Optional[Sequence[int]] = None,
+    ) -> None:
+        """`tasks` overrides the per-env task ids (default: each env's
+        `task_id` attribute, else 0). `device` pins policy inference — see
+        `Actor` for the committed-inputs mechanism."""
+        if not envs:
+            raise ValueError("VectorActor needs at least one env")
+        self._id = actor_id
+        self._envs = list(envs)
+        self._agent = agent
+        self._param_store = param_store
+        self._enqueue = enqueue
+        self._unroll_length = unroll_length
+        self._on_episode_return = on_episode_return
+        self._step_fn = _jitted_actor_step(agent)
+        self._device = device
+        self._key = jax.random.key(seed)
+        if device is not None:
+            self._key = jax.device_put(self._key, device)
+        self.error: Optional[BaseException] = None
+        self.num_unrolls = 0  # counts emitted trajectories (E per cycle)
+
+        E = len(self._envs)
+        self._tasks = (
+            [int(t) for t in tasks]
+            if tasks is not None
+            else [int(getattr(e, "task_id", 0)) for e in self._envs]
+        )
+        if len(self._tasks) != E:
+            raise ValueError("tasks must have one entry per env")
+        obs0 = []
+        for i, env in enumerate(self._envs):
+            obs, _ = env.reset(seed=seed + i)
+            obs0.append(np.asarray(obs))
+        self._obs = np.stack(obs0)  # [E, ...]
+        self._first = np.ones((E,), np.bool_)
+        self._state = agent.initial_state(E)
+        self._episode_return = np.zeros((E,), np.float64)
+        self._episode_len = np.zeros((E,), np.int64)
+
+    @property
+    def num_envs(self) -> int:
+        return len(self._envs)
+
+    def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
+        """Step all E envs for T steps; return E single-env trajectories."""
+        T, E = self._unroll_length, len(self._envs)
+        if self._device is not None:
+            params = jax.device_put(params, self._device)
+        obs_buf = np.empty((T + 1, E, *self._obs.shape[1:]), self._obs.dtype)
+        first_buf = np.empty((T + 1, E), np.bool_)
+        actions = np.empty((T, E), np.int32)
+        rewards = np.empty((T, E), np.float32)
+        cont = np.empty((T, E), np.float32)
+        logits_buf = None
+        start_state = jax.tree.map(np.asarray, self._state)
+
+        for t in range(T):
+            obs_buf[t] = self._obs
+            first_buf[t] = self._first
+            self._key, out = self._step_fn(
+                params,
+                self._key,
+                jnp.asarray(self._obs),
+                jnp.asarray(self._first),
+                self._state,
+            )
+            self._state = out.state
+            acts = np.asarray(out.action)
+            if logits_buf is None:
+                logits_buf = np.empty(
+                    (T, E, out.policy_logits.shape[-1]), np.float32
+                )
+            logits_buf[t] = np.asarray(out.policy_logits)
+
+            # The host-side env loop: the only per-env Python work left.
+            for i, env in enumerate(self._envs):
+                next_obs, reward, terminated, truncated, _ = env.step(
+                    int(acts[i])
+                )
+                # Truncation is treated as termination (standard for these
+                # frameworks; CartPole's 500-step cap etc.).
+                done = bool(terminated or truncated)
+                actions[t, i] = acts[i]
+                rewards[t, i] = float(reward)
+                cont[t, i] = 0.0 if done else 1.0
+                self._episode_return[i] += float(reward)
+                self._episode_len[i] += 1
+                if done:
+                    if self._on_episode_return is not None:
+                        self._on_episode_return(
+                            self._id,
+                            float(self._episode_return[i]),
+                            int(self._episode_len[i]),
+                        )
+                    self._episode_return[i] = 0.0
+                    self._episode_len[i] = 0
+                    next_obs, _ = env.reset()
+                self._obs[i] = np.asarray(next_obs)
+                self._first[i] = done
+
+        obs_buf[T] = self._obs
+        first_buf[T] = self._first
+
+        return [
+            Trajectory(
+                obs=obs_buf[:, i],
+                first=first_buf[:, i],
+                actions=actions[:, i],
+                behaviour_logits=logits_buf[:, i],
+                rewards=rewards[:, i],
+                cont=cont[:, i],
+                agent_state=jax.tree.map(
+                    lambda x: x[i : i + 1], start_state
+                ),
+                actor_id=self._id,
+                param_version=param_version,
+                task=self._tasks[i],
+            )
+            for i in range(E)
+        ]
+
+    def unroll_and_push(self) -> None:
+        version, params = self._param_store.get()
+        for traj in self.unroll(params, version):
+            self._enqueue(traj)
+            self.num_unrolls += 1
+
+    def run(
+        self,
+        stop_event: threading.Event,
+        max_unrolls: Optional[int] = None,
+    ) -> None:
+        """Actor loop; same contract as `Actor.run` (supervisor-compatible)."""
+        try:
+            while not stop_event.is_set():
+                if max_unrolls is not None and self.num_unrolls >= max_unrolls:
+                    return
+                try:
+                    self.unroll_and_push()
+                except QueueClosed:
+                    return
+        except BaseException as e:  # noqa: BLE001 — watchdog needs any error
+            self.error = e
+            raise
