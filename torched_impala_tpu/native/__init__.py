@@ -1,0 +1,105 @@
+"""Native (C++) runtime components, loaded via ctypes with pure-Python
+fallbacks.
+
+`get_batcher_lib()` compiles `batcher.cpp` on first use (g++ is part of the
+target image; SURVEY.md Appendix B toolchain) and caches the .so next to the
+source. Every caller must handle `None` (no compiler / failed build) and
+fall back to the numpy path — native code is an optimization here, never a
+requirement (the reference itself has no first-party native code,
+SURVEY.md §3a)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+_ABI_VERSION = 2
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _source_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "batcher.cpp")
+
+
+def _so_path() -> str:
+    return os.path.join(
+        os.path.dirname(__file__), f"_batcher_v{_ABI_VERSION}.so"
+    )
+
+
+def _build() -> str:
+    """Compile batcher.cpp -> .so (atomic rename, so concurrent processes
+    can't observe a half-written library)."""
+    so = _so_path()
+    src = _source_path()
+    # Rebuild when the source is newer: the ABI tag only catches
+    # deliberate version bumps, not same-version source edits.
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    fd, tmp = tempfile.mkstemp(
+        suffix=".so", dir=os.path.dirname(so), prefix=".build-"
+    )
+    os.close(fd)
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O3",
+                "-shared",
+                "-fPIC",
+                "-std=c++17",
+                "-pthread",
+                src,
+                "-o",
+                tmp,
+            ],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        os.replace(tmp, so)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return so
+
+
+def get_batcher_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native batcher, or None if unavailable on this host."""
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        try:
+            lib = ctypes.CDLL(_build())
+            lib.stack_leaf.argtypes = [
+                ctypes.c_void_p,  # dst base
+                ctypes.c_void_p,  # srcs (int64 pointer array)
+                ctypes.c_void_p,  # src_strides (int64 array)
+                ctypes.c_int64,  # B
+                ctypes.c_int64,  # t_count
+                ctypes.c_int64,  # inner_bytes
+                ctypes.c_int32,  # max_threads
+            ]
+            lib.stack_leaf.restype = None
+            lib.batcher_abi_version.restype = ctypes.c_int32
+            if lib.batcher_abi_version() != _ABI_VERSION:
+                raise RuntimeError("stale native batcher ABI")
+            _lib = lib
+        except BaseException as e:  # noqa: BLE001 — any failure => fallback
+            print(
+                f"[native] batcher unavailable, using numpy fallback: {e!r}",
+                file=sys.stderr,
+            )
+            _lib = None
+        return _lib

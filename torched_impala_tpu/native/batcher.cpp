@@ -1,0 +1,76 @@
+// Native trajectory-batch assembler.
+//
+// The TPU-native runtime's answer to the reference's shared-memory tensor
+// IPC hot path (SURVEY.md §3a: the reference's native substrate is
+// third-party — torch.multiprocessing shared-memory copies; ours is this).
+// The learner's batcher thread must assemble B time-major unrolls into one
+// [T(+1), B, ...] batch per learner step. Doing that with per-leaf numpy
+// calls holds the GIL for the whole memcpy volume (tens of MB per batch at
+// Atari scale), stalling every actor thread in the process.
+//
+// The Python side makes ONE ctypes call per batch leaf (ctypes drops the
+// GIL for its duration), passing B source pointers; the B slot copies fan
+// out over std::threads only when the byte volume makes the spawn cost
+// irrelevant.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Copy source b (strided over t by src_stride bytes, inner block of
+// inner_bytes) into batch slot b of dst, where dst is [t_count, B, inner].
+inline void copy_slot(char* dst, const char* src, int64_t b, int64_t B,
+                      int64_t t_count, int64_t inner_bytes,
+                      int64_t src_stride) {
+  char* d = dst + b * inner_bytes;
+  const int64_t dst_stride = B * inner_bytes;
+  if (src_stride == inner_bytes && B == 1) {
+    std::memcpy(d, src, static_cast<size_t>(t_count * inner_bytes));
+    return;
+  }
+  for (int64_t t = 0; t < t_count; ++t) {
+    std::memcpy(d + t * dst_stride, src + t * src_stride,
+                static_cast<size_t>(inner_bytes));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Stack B sources into dst[:, b] for b in [0, B). `srcs`/`src_strides` are
+// B-element arrays. Spawns up to max_threads workers when the total volume
+// exceeds ~16MB (below that a single thread matches memcpy bandwidth and
+// spawn overhead would dominate).
+void stack_leaf(char* dst, const char* const* srcs,
+                const int64_t* src_strides, int64_t B, int64_t t_count,
+                int64_t inner_bytes, int32_t max_threads) {
+  const int64_t total = B * t_count * inner_bytes;
+  if (total < (16 << 20) || max_threads <= 1 || B == 1) {
+    for (int64_t b = 0; b < B; ++b) {
+      copy_slot(dst, srcs[b], b, B, t_count, inner_bytes, src_strides[b]);
+    }
+    return;
+  }
+  int32_t workers =
+      max_threads < static_cast<int32_t>(B) ? max_threads
+                                            : static_cast<int32_t>(B);
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  for (int32_t w = 0; w < workers; ++w) {
+    threads.emplace_back([=]() {
+      for (int64_t b = w; b < B; b += workers) {
+        copy_slot(dst, srcs[b], b, B, t_count, inner_bytes, src_strides[b]);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Version tag so the Python side can cache-bust stale .so builds.
+int32_t batcher_abi_version() { return 2; }
+
+}  // extern "C"

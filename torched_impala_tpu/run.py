@@ -53,6 +53,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "env steps to exercise supervisor restarts")
     p.add_argument("--max-actor-restarts", type=int, default=10,
                    help="per-actor supervisor restart budget")
+    p.add_argument("--native-batcher", action="store_true",
+                   help="assemble batches with the C++ batcher (see "
+                        "LearnerConfig.native_batcher for the tradeoff)")
     # Logging / checkpointing.
     p.add_argument("--logger", choices=("print", "csv", "tb", "jsonl", "null"),
                    default="print")
@@ -140,6 +143,12 @@ def main(argv=None) -> int:
             if checkpointer is not None:
                 checkpointer.close()
 
+    learner_config = configs.make_learner_config(cfg)
+    if args.native_batcher:
+        learner_config = dataclasses.replace(
+            learner_config, native_batcher=True
+        )
+
     env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
     if args.chaos:
         from torched_impala_tpu.envs.fake import CrashingEnv
@@ -175,7 +184,7 @@ def main(argv=None) -> int:
             env_factory=env_factory,
             example_obs=configs.example_obs(cfg),
             num_actors=cfg.num_actors,
-            learner_config=configs.make_learner_config(cfg),
+            learner_config=learner_config,
             optimizer=configs.make_optimizer(cfg),
             total_steps=total_steps,
             seed=args.seed,
