@@ -37,7 +37,12 @@ def main() -> None:
     log(f"bench: backend={jax.default_backend()} T={T} B={B}")
 
     agent = Agent(
-        ImpalaNet(num_actions=num_actions, torso=AtariShallowTorso())
+        ImpalaNet(
+            num_actions=num_actions,
+            # bf16 torso matches the pong preset (configs.py): conv FLOPs
+            # on the MXU fast path, heads/loss in f32.
+            torso=AtariShallowTorso(dtype=jnp.bfloat16),
+        )
     )
     learner = Learner(
         agent=agent,

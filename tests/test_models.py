@@ -177,3 +177,66 @@ def test_sampled_actions_follow_logits():
         agent.initial_state(512),
     )
     assert out.action.min() >= 0 and out.action.max() < 4
+
+
+class TestBF16Compute:
+    def test_bf16_torso_outputs_f32_and_matches_f32_loosely(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torched_impala_tpu.models import (
+            Agent,
+            AtariShallowTorso,
+            ImpalaNet,
+        )
+
+        rng = np.random.default_rng(0)
+        obs = rng.integers(0, 256, size=(2, 3, 84, 84, 4)).astype(np.uint8)
+        first = np.zeros((2, 3), np.bool_)
+
+        outs = {}
+        for name, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+            agent = Agent(
+                ImpalaNet(
+                    num_actions=5,
+                    torso=AtariShallowTorso(dtype=dtype),
+                    use_lstm=True,
+                    lstm_size=16,
+                )
+            )
+            params = agent.init_params(
+                jax.random.key(0), jnp.zeros((84, 84, 4), jnp.uint8)
+            )
+            net_out, _ = agent.unroll(
+                params, jnp.asarray(obs), jnp.asarray(first),
+                agent.initial_state(3),
+            )
+            # Heads and loss math must stay float32 regardless of torso dtype.
+            assert net_out.policy_logits.dtype == jnp.float32
+            assert net_out.values.dtype == jnp.float32
+            outs[name] = net_out
+
+        # Same init (same seed/param shapes+dtypes): bf16 compute should
+        # track f32 within bf16's ~3 decimal digits.
+        np.testing.assert_allclose(
+            np.asarray(outs["f32"].policy_logits),
+            np.asarray(outs["bf16"].policy_logits),
+            rtol=0.1,
+            atol=0.1,
+        )
+
+    def test_bf16_params_stay_float32(self):
+        import jax
+        import jax.numpy as jnp
+
+        from torched_impala_tpu import configs
+
+        cfg = configs.REGISTRY["pong"]
+        assert cfg.compute_dtype == "bfloat16"
+        agent = configs.make_agent(cfg)
+        params = agent.init_params(
+            jax.random.key(0), jnp.asarray(configs.example_obs(cfg))
+        )
+        for leaf in jax.tree.leaves(params):
+            assert leaf.dtype == jnp.float32, leaf.dtype

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Optional
 
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -54,6 +55,10 @@ class ExperimentConfig:
     model: str = "mlp"  # mlp | shallow_cnn | deep_resnet
     use_lstm: bool = False
     lstm_size: int = 256
+    # Torso compute dtype ("float32" | "bfloat16"). bf16 keeps the conv
+    # FLOPs on the MXU's fast path; params, LSTM core, heads, and all loss
+    # math stay float32.
+    compute_dtype: str = "float32"
     # Scale. `num_actors` is actor *threads*; each steps `envs_per_actor`
     # envs with one batched policy dispatch per timestep (VectorActor).
     num_actors: int = 4
@@ -86,12 +91,18 @@ class ExperimentConfig:
 
 
 def make_agent(cfg: ExperimentConfig) -> Agent:
+    if cfg.compute_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unknown compute_dtype {cfg.compute_dtype!r}; "
+            "expected 'float32' or 'bfloat16'"
+        )
+    dtype = jnp.dtype(cfg.compute_dtype)
     if cfg.model == "mlp":
-        torso = MLPTorso()
+        torso = MLPTorso(dtype=dtype)
     elif cfg.model == "shallow_cnn":
-        torso = AtariShallowTorso()
+        torso = AtariShallowTorso(dtype=dtype)
     elif cfg.model == "deep_resnet":
-        torso = AtariDeepTorso()
+        torso = AtariDeepTorso(dtype=dtype)
     else:
         raise ValueError(f"unknown model {cfg.model!r}")
     net = ImpalaNet(
@@ -224,6 +235,7 @@ PONG = ExperimentConfig(
     obs_dtype="uint8",
     num_actions=6,
     model="shallow_cnn",
+    compute_dtype="bfloat16",
     num_actors=32,
     unroll_length=20,
     batch_size=32,
@@ -238,6 +250,7 @@ BREAKOUT = ExperimentConfig(
     obs_dtype="uint8",
     num_actions=4,
     model="deep_resnet",
+    compute_dtype="bfloat16",
     use_lstm=True,
     num_actors=256,
     unroll_length=20,
@@ -253,6 +266,7 @@ PROCGEN = ExperimentConfig(
     obs_dtype="uint8",
     num_actions=15,
     model="deep_resnet",
+    compute_dtype="bfloat16",
     num_actors=512,
     unroll_length=20,
     batch_size=64,
@@ -269,6 +283,7 @@ DMLAB30 = ExperimentConfig(
     num_actions=15,
     num_tasks=30,
     model="deep_resnet",
+    compute_dtype="bfloat16",
     use_lstm=True,
     num_actors=256,
     unroll_length=100,

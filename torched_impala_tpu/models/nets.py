@@ -113,6 +113,11 @@ class ImpalaNet(nn.Module):
             features = self.torso(obs)
 
         if self.use_lstm:
+            # The recurrent core runs in float32 regardless of the torso's
+            # compute dtype (bf16 torsos feed f32 features): the scan carry
+            # dtype must be stable across steps, and the LSTM is a
+            # negligible share of the FLOPs next to the convs on the MXU.
+            features = features.astype(jnp.float32)
             cell = nn.OptimizedLSTMCell(self.lstm_size, name="lstm")
             if unroll:
                 scan = nn.scan(
