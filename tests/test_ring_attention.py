@@ -1,0 +1,99 @@
+"""Ring attention vs dense oracle on the 8-virtual-device CPU mesh.
+
+The op must be EXACT (online softmax, not an approximation): causal and
+full attention are compared against a plain dense softmax reference at f32
+tolerances, across uneven shapes and device counts, plus gradient flow
+through the sharded op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torched_impala_tpu.parallel.ring_attention import (
+    ring_attention_sharded,
+    seq_mesh,
+)
+
+
+def dense_attention(q, k, v, causal):
+    T = q.shape[0]
+    dh = q.shape[-1]
+    logits = jnp.einsum("tbhd,sbhd->tbhs", q, k) / jnp.sqrt(float(dh))
+    if causal:
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    return jnp.einsum(
+        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), v
+    )
+
+
+def _qkv(rng, T, B=2, H=2, Dh=8):
+    return tuple(
+        jnp.asarray(rng.normal(size=(T, B, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_matches_dense(self, causal, n_dev):
+        rng = np.random.default_rng(0)
+        T = n_dev * 5  # uneven local blocks vs heads etc.
+        q, k, v = _qkv(rng, T)
+        mesh = seq_mesh(n_dev)
+        out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+        ref = dense_attention(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
+
+    def test_single_device_degenerates_to_dense(self):
+        rng = np.random.default_rng(1)
+        q, k, v = _qkv(rng, 12)
+        mesh = seq_mesh(1)
+        out = ring_attention_sharded(q, k, v, mesh, causal=True)
+        ref = dense_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
+
+    def test_extreme_logits_stay_stable(self):
+        # Online softmax must survive large-magnitude logits (the reason
+        # for the running max).
+        rng = np.random.default_rng(2)
+        q, k, v = _qkv(rng, 16)
+        q = q * 30.0  # logits ~ +-hundreds
+        mesh = seq_mesh(4)
+        out = ring_attention_sharded(q, k, v, mesh, causal=True)
+        ref = dense_attention(q, k, v, True)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
+    def test_gradients_flow_and_match_dense(self):
+        rng = np.random.default_rng(3)
+        q, k, v = _qkv(rng, 8)
+        mesh = seq_mesh(4)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                ring_attention_sharded(q, k, v, mesh, causal=True) ** 2
+            )
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, True) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5
+            )
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

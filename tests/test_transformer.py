@@ -1,0 +1,199 @@
+"""Transformer core tests: KV-cache step/unroll consistency, episode
+isolation, cross-unroll memory, and the full learner path.
+
+The invariants mirror what the LSTM reset-core tests pin for recurrence:
+step mode must equal unroll mode, episode starts must cut the context, and
+the cache must carry memory across unrolls exactly like the stored LSTM
+carry does.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.models import (
+    Agent,
+    ImpalaNet,
+    MLPTorso,
+    TransformerCore,
+)
+
+XF = (("d_model", 32), ("num_layers", 2), ("num_heads", 2), ("window", 16))
+
+
+def _net(num_actions=3):
+    return ImpalaNet(
+        num_actions=num_actions,
+        torso=MLPTorso(hidden_sizes=(16,)),
+        core="transformer",
+        transformer=XF,
+    )
+
+
+def _init(net, obs_dim=4):
+    agent = Agent(net)
+    params = agent.init_params(
+        jax.random.key(0), jnp.zeros((obs_dim,), jnp.float32)
+    )
+    return agent, params
+
+
+class TestCore:
+    def test_step_equals_unroll(self):
+        # Driving the core one step at a time through the KV cache must
+        # reproduce the parallel unroll exactly (same params, same math).
+        T, B = 7, 2
+        rng = np.random.default_rng(0)
+        agent, params = _init(_net())
+        obs = jnp.asarray(rng.normal(size=(T, B, 4)), jnp.float32)
+        first = jnp.asarray(
+            [[True, False], [False, False], [False, True], [False, False],
+             [True, False], [False, False], [False, False]]
+        )
+        out_unroll, _ = agent.unroll(
+            params, obs, first, agent.initial_state(B)
+        )
+
+        state = agent.initial_state(B)
+        step_logits = []
+        for t in range(T):
+            net_out, state = agent.net.apply(
+                params, obs[t], first[t], state, unroll=False
+            )
+            step_logits.append(net_out.policy_logits)
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(step_logits)),
+            np.asarray(out_unroll.policy_logits),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+    def test_episode_start_cuts_context(self):
+        # Two histories differing only BEFORE an episode boundary must
+        # produce identical outputs after it.
+        T, B = 6, 1
+        rng = np.random.default_rng(1)
+        agent, params = _init(_net())
+        boundary = 3
+        obs_a = rng.normal(size=(T, B, 4)).astype(np.float32)
+        obs_b = obs_a.copy()
+        obs_b[:boundary] = rng.normal(size=(boundary, B, 4))
+        first = np.zeros((T, B), bool)
+        first[0] = True
+        first[boundary] = True  # new episode: context must reset here
+
+        outs = []
+        for obs in (obs_a, obs_b):
+            out, _ = agent.unroll(
+                params, jnp.asarray(obs), jnp.asarray(first),
+                agent.initial_state(B),
+            )
+            outs.append(np.asarray(out.policy_logits))
+        np.testing.assert_array_equal(
+            outs[0][boundary:], outs[1][boundary:]
+        )
+        assert not np.allclose(outs[0][:boundary], outs[1][:boundary])
+
+    def test_cache_carries_memory_across_unrolls(self):
+        # unroll([0:T]) == unroll([0:k]) then unroll([k:T]) with carried
+        # state — the actor/learner cross-unroll contract.
+        T, k, B = 8, 3, 2
+        rng = np.random.default_rng(2)
+        agent, params = _init(_net())
+        obs = jnp.asarray(rng.normal(size=(T, B, 4)), jnp.float32)
+        first = np.zeros((T, B), bool)
+        first[0] = True
+        first[5, 1] = True  # an episode break inside the second chunk
+        first = jnp.asarray(first)
+
+        full, _ = agent.unroll(params, obs, first, agent.initial_state(B))
+        out1, mid_state = agent.unroll(
+            params, obs[:k], first[:k], agent.initial_state(B)
+        )
+        out2, _ = agent.unroll(params, obs[k:], first[k:], mid_state)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate(
+                [out1.policy_logits, out2.policy_logits]
+            )),
+            np.asarray(full.policy_logits),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+    def test_sliding_window_evicts_old_context(self):
+        # With window W, a token W+1 steps in the past is out of context:
+        # outputs must match a history where that token differs.
+        W = 4
+        net = ImpalaNet(
+            num_actions=3,
+            torso=MLPTorso(hidden_sizes=(16,)),
+            core="transformer",
+            transformer=(
+                ("d_model", 32), ("num_layers", 1), ("num_heads", 2),
+                ("window", W),
+            ),
+        )
+        agent, params = _init(net)
+        T, B = W + 3, 1
+        rng = np.random.default_rng(3)
+        obs_a = rng.normal(size=(T, B, 4)).astype(np.float32)
+        obs_b = obs_a.copy()
+        obs_b[0] = rng.normal(size=(B, 4))  # differs only at t=0
+        first = np.zeros((T, B), bool)
+        first[0] = True
+
+        # Drive step-by-step so the cache actually slides (unroll mode
+        # keeps the whole unroll in context).
+        logits = {}
+        for name, obs in (("a", obs_a), ("b", obs_b)):
+            state = agent.initial_state(B)
+            ls = []
+            for t in range(T):
+                net_out, state = agent.net.apply(
+                    params, jnp.asarray(obs[t]),
+                    jnp.asarray(first[t]), state, unroll=False,
+                )
+                ls.append(np.asarray(net_out.policy_logits))
+            logits[name] = np.stack(ls)
+        # While t=0 is in the window the outputs differ...
+        assert not np.allclose(logits["a"][1], logits["b"][1])
+        # ...once it slid out (query at t > W), they must be identical.
+        np.testing.assert_array_equal(logits["a"][-1], logits["b"][-1])
+
+
+class TestLearnerIntegration:
+    def test_train_end_to_end_with_transformer_policy(self):
+        from torched_impala_tpu.envs.fake import FakeDiscreteEnv
+        from torched_impala_tpu.runtime import LearnerConfig
+        from torched_impala_tpu.runtime.loop import train
+
+        agent = Agent(_net())
+        result = train(
+            agent=agent,
+            env_factory=lambda seed: FakeDiscreteEnv(
+                obs_shape=(4,), num_actions=3, episode_len=6, seed=seed
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            envs_per_actor=2,
+            learner_config=LearnerConfig(batch_size=4, unroll_length=5),
+            optimizer=optax.rmsprop(1e-3, decay=0.99, eps=1e-7),
+            total_steps=3,
+            log_every=1,
+        )
+        assert result.learner.num_steps == 3
+        assert np.isfinite(result.final_logs["total_loss"])
+
+    def test_core_state_is_dp_shardable(self):
+        # Every state leaf is batch-major so state_sharding (P('data'))
+        # applies cleanly.
+        core = TransformerCore(**dict(XF))
+        state = core.initial_state(8)
+        for leaf in jax.tree.leaves(state):
+            assert leaf.shape[0] == 8
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

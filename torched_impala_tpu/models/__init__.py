@@ -12,6 +12,10 @@ from torched_impala_tpu.models.torsos import (  # noqa: F401
     MLPTorso,
     ResidualBlock,
 )
+from torched_impala_tpu.models.transformer import (  # noqa: F401
+    TransformerCore,
+    TransformerCoreState,
+)
 
 __all__ = [
     "Agent",
@@ -23,4 +27,6 @@ __all__ = [
     "AtariShallowTorso",
     "MLPTorso",
     "ResidualBlock",
+    "TransformerCore",
+    "TransformerCoreState",
 ]

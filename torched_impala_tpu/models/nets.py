@@ -56,26 +56,62 @@ def _core_step(cell: nn.Module, carry, inputs):
 
 
 class ImpalaNet(nn.Module):
-    """Policy network: `torso` feature extractor, optional LSTM core, heads.
+    """Policy network: `torso` feature extractor, optional temporal core,
+    heads.
 
     Attributes:
       num_actions: size of the categorical action space.
       torso: a Flax module mapping `[N, ...obs]` → `[N, F]` features.
-      use_lstm: insert an LSTM(lstm_size) core between torso and heads.
+      use_lstm: insert an LSTM(lstm_size) core between torso and heads
+        (equivalent to core="lstm"; kept for the reference-parity surface).
+      core: "none" | "lstm" | "transformer" — the temporal core. The
+        transformer core (models/transformer.py) attends causally over the
+        unroll with a sliding-window KV cache as its recurrent state
+        (long-context policies; SP-ready, see parallel/ring_attention.py).
       lstm_size: LSTM hidden width (reference uses 256, SURVEY.md §1 item 4).
+      transformer: TransformerCore hyper-parameters, used when
+        core="transformer" (a dict so the module stays hashable; keys are
+        TransformerCore fields).
       num_values: width of the value head (1, or num_tasks under PopArt).
     """
 
     num_actions: int
     torso: nn.Module
     use_lstm: bool = False
+    core: str = "auto"  # "auto" resolves via use_lstm for back-compat
     lstm_size: int = 256
+    transformer: tuple = ()  # e.g. (("d_model", 128), ("num_layers", 2))
     num_values: int = 1
+
+    def _core_kind(self) -> str:
+        if self.core != "auto":
+            return self.core
+        return "lstm" if self.use_lstm else "none"
+
+    def _transformer_core(self, *, bound: bool):
+        """`bound=True` names the submodule (only legal inside apply);
+        `bound=False` builds an anonymous instance for pure config-only
+        methods like initial_state (flax forbids `name=` outside a parent
+        module context)."""
+        from torched_impala_tpu.models.transformer import TransformerCore
+
+        kwargs = dict(self.transformer)
+        if bound:
+            return TransformerCore(name="transformer", **kwargs)
+        # parent=None detaches the instance from the calling module context
+        # (initial_state runs inside a flax-wrapped method, which would
+        # otherwise try to adopt the child into a scopeless parent).
+        return TransformerCore(parent=None, **kwargs)
 
     def initial_state(self, batch_size: int) -> NetState:
         """Zero recurrent state; a pure function of the config (no params)."""
-        if not self.use_lstm:
+        kind = self._core_kind()
+        if kind == "none":
             return ()
+        if kind == "transformer":
+            return self._transformer_core(bound=False).initial_state(
+                batch_size
+            )
         shape = (batch_size, self.lstm_size)
         return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
 
@@ -112,7 +148,18 @@ class ImpalaNet(nn.Module):
         else:
             features = self.torso(obs)
 
-        if self.use_lstm:
+        kind = self._core_kind()
+        if kind == "transformer":
+            core = self._transformer_core(bound=True)
+            if unroll:
+                core_out, state = core(features, first, state)
+            else:
+                # Step mode is the T=1 unroll; the KV cache is the carry.
+                core_out, state = core(
+                    features[None], first[None], state
+                )
+                core_out = core_out[0]
+        elif kind == "lstm":
             # The recurrent core runs in float32 regardless of the torso's
             # compute dtype (bf16 torsos feed f32 features): the scan carry
             # dtype must be stable across steps, and the LSTM is a

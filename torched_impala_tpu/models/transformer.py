@@ -1,0 +1,189 @@
+"""Transformer policy core: causal attention over the unroll time axis.
+
+An alternative temporal core to the LSTM (the reference's recurrence is an
+LSTM; SURVEY.md §6 notes that if a transformer policy were added, sharding
+the time axis with collective-permute ring attention is the natural TPU
+path — `parallel/ring_attention.py` provides exactly that op). This core
+makes long-context policies first-class:
+
+- **unroll mode** processes the whole `[T, B]` unroll in parallel (no
+  sequential scan — attention is the transformer's advantage on the MXU);
+- **step mode** is the same code path with T=1, carrying a sliding-window
+  KV cache as the recurrent state, so actors pay one cached-attention step
+  per env step;
+- **episode boundaries** are handled with segment ids: each row carries a
+  running episode counter, queries attend only to cache/unroll entries
+  from the same episode (the transformer analog of `hk.ResetCore`
+  zeroing the LSTM carry);
+- **positions** are rotary with absolute per-row step indices — relative
+  offsets are what matters, caches store post-rotary keys.
+
+State layout (all float32/int32, batch-major so the DP learner shards it
+on axis 0 like any recurrent state):
+  k_cache/v_cache `[B, L, W, D]`, kv_seg/kv_pos `[B, W]`,
+  pos `[B]` next absolute index, seg `[B]` episode counter.
+Fresh state has kv_seg = -1 (matches no real segment => empty context).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class TransformerCoreState(NamedTuple):
+    k_cache: jax.Array  # [B, L, W, D]
+    v_cache: jax.Array  # [B, L, W, D]
+    kv_seg: jax.Array  # [B, W] int32, -1 = empty slot
+    kv_pos: jax.Array  # [B, W] int32 absolute positions
+    pos: jax.Array  # [B] int32 next absolute position
+    seg: jax.Array  # [B] int32 current episode counter
+
+
+def rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Apply rotary embeddings. x `[..., H, Dh]`, positions broadcastable to
+    x's leading dims (`[...]`)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None, None] * freqs  # [...,1,half]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
+class _Block(nn.Module):
+    """Pre-LN transformer block; attention consumes explicit K/V + mask."""
+
+    d_model: int
+    num_heads: int
+    mlp_factor: int = 4
+
+    @nn.compact
+    def __call__(self, x, k_ctx, v_ctx, mask, q_pos):
+        """x `[B, T, D]` queries; k_ctx/v_ctx `[B, S, D]` context (cache +
+        current tokens, already projected by THIS block's kv projections —
+        see TransformerCore); mask `[B, T, S]` bool; q_pos `[B, T]` int32."""
+        B, T, D = x.shape
+        H = self.num_heads
+        dh = D // H
+        h = nn.LayerNorm(name="ln_attn")(x)
+        q = nn.Dense(D, name="q_proj")(h).reshape(B, T, H, dh)
+        q = rotary(q, q_pos)
+        k = k_ctx.reshape(B, -1, H, dh)  # already rotary'd at projection
+        v = v_ctx.reshape(B, -1, H, dh)
+        logits = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(float(dh))
+        logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+        attn = jax.nn.softmax(logits, axis=-1)
+        # Fully-masked rows (empty context can't happen: self always
+        # visible) — no special case needed.
+        out = jnp.einsum("bhts,bshd->bthd", attn, v).reshape(B, T, D)
+        x = x + nn.Dense(D, name="o_proj")(out)
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        h = nn.Dense(self.mlp_factor * D, name="mlp_in")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(D, name="mlp_out")(h)
+        return x
+
+
+class TransformerCore(nn.Module):
+    """L pre-LN blocks over time with sliding-window KV cache.
+
+    Call with features `[T, B, F]` (time-major, like the LSTM core),
+    `first` `[T, B]`, and a `TransformerCoreState`; returns
+    (`[T, B, d_model]`, new state). Step mode = T=1.
+    """
+
+    d_model: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    window: int = 128
+    mlp_factor: int = 4
+
+    def initial_state(self, batch_size: int) -> TransformerCoreState:
+        B, L, W, D = batch_size, self.num_layers, self.window, self.d_model
+        return TransformerCoreState(
+            k_cache=jnp.zeros((B, L, W, D), jnp.float32),
+            v_cache=jnp.zeros((B, L, W, D), jnp.float32),
+            kv_seg=jnp.full((B, W), -1, jnp.int32),
+            kv_pos=jnp.zeros((B, W), jnp.int32),
+            pos=jnp.zeros((B,), jnp.int32),
+            seg=jnp.zeros((B,), jnp.int32),
+        )
+
+    @nn.compact
+    def __call__(self, features, first, state: TransformerCoreState):
+        T, B, _ = features.shape
+        W, L, D = self.window, self.num_layers, self.d_model
+        x = nn.Dense(D, name="in_proj")(
+            features.astype(jnp.float32)
+        ).transpose(1, 0, 2)  # [B, T, D]
+
+        first = first.transpose(1, 0)  # [B, T]
+        # Segment id of each query step: running episode counter + starts
+        # seen so far in this unroll (a step flagged `first` begins a NEW
+        # segment, so the cumsum includes it).
+        seg_q = state.seg[:, None] + jnp.cumsum(
+            first.astype(jnp.int32), axis=1
+        )  # [B, T]
+        pos_q = state.pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+        # Visibility masks.
+        cache_vis = (seg_q[:, :, None] == state.kv_seg[:, None, :])  # [B,T,W]
+        causal = (
+            jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        )  # [T, T'] queries attend to earlier-or-self unroll steps
+        intra_vis = (
+            (seg_q[:, :, None] == seg_q[:, None, :]) & causal[None, :, :]
+        )  # [B, T, T]
+        mask = jnp.concatenate([cache_vis, intra_vis], axis=2)  # [B,T,W+T]
+
+        new_k_layers = []
+        new_v_layers = []
+        for layer in range(L):
+            # K/V of current tokens for this layer (cache stores post-
+            # rotary keys; values raw).
+            kv_in = nn.LayerNorm(name=f"ln_kv_{layer}")(x)
+            k_new = nn.Dense(D, name=f"k_proj_{layer}")(kv_in)
+            k_new = rotary(
+                k_new.reshape(B, T, self.num_heads, D // self.num_heads),
+                pos_q,
+            ).reshape(B, T, D)
+            v_new = nn.Dense(D, name=f"v_proj_{layer}")(kv_in)
+            k_ctx = jnp.concatenate(
+                [state.k_cache[:, layer], k_new], axis=1
+            )  # [B, W+T, D]
+            v_ctx = jnp.concatenate([state.v_cache[:, layer], v_new], axis=1)
+            x = _Block(
+                d_model=D,
+                num_heads=self.num_heads,
+                mlp_factor=self.mlp_factor,
+                name=f"block_{layer}",
+            )(x, k_ctx, v_ctx, mask, pos_q)
+            new_k_layers.append(k_ctx[:, -W:])
+            new_v_layers.append(v_ctx[:, -W:])
+
+        out = nn.LayerNorm(name="ln_out")(x)
+
+        combined_seg = jnp.concatenate(
+            [state.kv_seg, seg_q], axis=1
+        )[:, -W:]
+        combined_pos = jnp.concatenate(
+            [state.kv_pos, pos_q], axis=1
+        )[:, -W:]
+        new_state = TransformerCoreState(
+            k_cache=jnp.stack(new_k_layers, axis=1),
+            v_cache=jnp.stack(new_v_layers, axis=1),
+            kv_seg=combined_seg,
+            kv_pos=combined_pos,
+            pos=state.pos + T,
+            seg=seg_q[:, -1],
+        )
+        return out.transpose(1, 0, 2), new_state

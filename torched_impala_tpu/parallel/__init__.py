@@ -1,4 +1,5 @@
-"""Mesh/sharding layer: DP over ICI, model axis reserved (SURVEY.md §3b)."""
+"""Mesh/sharding layer: DP over ICI, model axis reserved, sequence-parallel
+ring attention for long-context policies (SURVEY.md §3b, §6)."""
 
 from torched_impala_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
@@ -8,6 +9,11 @@ from torched_impala_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     state_sharding,
 )
+from torched_impala_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+    seq_mesh,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -15,5 +21,8 @@ __all__ = [
     "batch_sharding",
     "make_mesh",
     "replicated",
+    "ring_attention",
+    "ring_attention_sharded",
+    "seq_mesh",
     "state_sharding",
 ]

@@ -1,0 +1,141 @@
+"""Ring attention: sequence-parallel attention over a sharded time axis.
+
+The TPU-native long-context path (SURVEY.md §6: "If a transformer policy
+were ever added, the natural TPU path is sharding T with collective-permute
+ring attention" — the transformer policy exists in models/transformer.py,
+and this op makes its attention scale past one device's memory).
+
+Mechanics (Ring Attention, Liu et al. 2023; blockwise online softmax,
+Milakov & Gimelshein 2018):
+
+- the sequence axis T is sharded over a mesh axis (`axis_name`); each
+  device holds local Q, K, V blocks `[T_local, B, H, Dh]`;
+- n devices run n rounds: compute blockwise attention of the local Q
+  against the currently-held KV block, then rotate the KV block to the
+  next device with `jax.lax.ppermute` — after n rounds every Q block has
+  seen every KV block while only one block of KV ever lives on a device;
+- softmax is accumulated online (running max `m`, normalizer `l`,
+  weighted-value accumulator) so the result is exact, not approximate;
+- causal masking uses global positions derived from `axis_index`, so
+  fully-future blocks contribute nothing (their probabilities are zeroed
+  explicitly — the accumulator never sees NaN from all-masked blocks).
+
+Use inside `jax.shard_map` with T sharded on `axis_name`; see
+`ring_attention_sharded` for a ready-made wrapper and the tests for the
+dense-equivalence oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention over the full (sharded) sequence.
+
+    Args:
+      q, k, v: `[T_local, B, H, Dh]` — the local shard of a `[T_global]`
+        sequence sharded over `axis_name`.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: mask position t from attending to positions > t (global).
+
+    Returns:
+      `[T_local, B, H, Dh]` attention output for the local queries.
+    """
+    n = jax.lax.psum(1, axis_name)  # devices on the ring (static)
+    my = jax.lax.axis_index(axis_name)
+    t_local = q.shape[0]
+    dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+
+    q32 = q.astype(jnp.float32)
+    acc = jnp.zeros(q.shape[:3] + (dh,), jnp.float32)
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)  # [Tl, B, H]
+    lse = jnp.zeros(q.shape[:3], jnp.float32)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    k_blk, v_blk = k.astype(jnp.float32), v.astype(jnp.float32)
+
+    q_pos = my * t_local + jnp.arange(t_local)  # global query positions
+
+    for i in range(n):
+        # Which global block this KV came from: after i rotations a device
+        # holds the block originally owned by (my - i) mod n.
+        src = (my - i) % n
+        logits = (
+            jnp.einsum("tbhd,sbhd->tbhs", q32, k_blk) * scale
+        )  # [Tl, B, H, Tl_kv]
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            visible = q_pos[:, None] >= k_pos[None, :]  # [Tl, Tl_kv]
+            logits = jnp.where(
+                visible[:, None, None, :], logits, NEG_INF
+            )
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # Zero fully-masked entries explicitly: when an entire block is
+        # masked, m_new can still be NEG_INF and exp(logit - m_new) would
+        # be exp(0) = 1 for masked slots.
+        p = jnp.where(
+            logits <= NEG_INF / 2,
+            0.0,
+            jnp.exp(logits - m_new[..., None]),
+        )
+        correction = jnp.where(
+            m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new)
+        )
+        lse = lse * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "tbhs,sbhd->tbhd", p, v_blk
+        )
+        m = m_new
+        if i + 1 < n:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+    return (acc / jnp.maximum(lse, 1e-30)[..., None]).astype(q.dtype)
+
+
+def seq_mesh(num_devices: int | None = None, *, devices=None) -> Mesh:
+    """A 1-axis ('seq',) mesh for sequence-parallel ops."""
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), ("seq",))
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    """Global-view wrapper: q/k/v `[T_global, B, H, Dh]`; shards T over
+    `axis_name`, runs the ring, returns the global `[T_global, ...]`
+    result. T_global must divide evenly by the axis size."""
+    spec = P(axis_name)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal
+    )
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
+    return sharded(put(q), put(k), put(v))
