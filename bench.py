@@ -11,28 +11,81 @@ Prints ONE JSON line. `vs_baseline` is value / 62_500: the reference has no
 published numbers (BASELINE.md), so the yardstick is the north-star target of
 1M env-frames/s on a v5e-16 (BASELINE.json:5) prorated to one chip
 (1_000_000 / 16 = 62_500 frames/s/chip).
+
+Hardened against this machine's documented traps (VERDICT round 1 weak #1):
+- PYTHONPATH being set breaks the axon TPU plugin registration → re-exec
+  with PYTHONPATH stripped before importing anything jax-touching.
+- The axon tunnel can wedge machine-wide (jax.devices() hangs for hours) →
+  probe the backend in a *subprocess* with a bounded timeout; on failure,
+  fall back to the CPU backend and label the JSON line with
+  `"backend": "cpu"` + a note (a CPU number is not the TPU metric, but it is
+  evidence the pipeline runs; the driver can tell them apart).
+- Any unexpected exception still emits ONE parseable JSON line with an
+  `error` key instead of a bare stack trace.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+if os.environ.get("PYTHONPATH"):
+    # Must happen before any jax import reaches the axon plugin.
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
+
+PROBE_TIMEOUT_S = 150  # first axon contact can take ~30s; wedged = hours
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def probe_tpu() -> bool:
+    """True iff the default (axon/TPU) backend initializes within a bound."""
+    code = "import jax; print([d.platform for d in jax.devices()])"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={k: v for k, v in os.environ.items() if k != "PYTHONPATH"},
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench: TPU probe timed out after {PROBE_TIMEOUT_S}s (wedged tunnel)")
+        return False
+    if proc.returncode != 0:
+        log(f"bench: TPU probe failed rc={proc.returncode}: {proc.stderr[-500:]}")
+        return False
+    log(f"bench: TPU probe ok: {proc.stdout.strip()}")
+    return True
+
+
 def main() -> None:
+    tpu_ok = probe_tpu()
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+    run_bench(jax, tpu_ok)
+
+
+def run_bench(jax, tpu_ok: bool) -> None:
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
     from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
     from torched_impala_tpu.ops import ImpalaLossConfig
     from torched_impala_tpu.runtime import Learner, LearnerConfig
 
-    T, B = 20, 256
+    # Full Pong shapes on TPU; a reduced batch on the CPU fallback so the
+    # run finishes in minutes (the number is labeled non-comparable anyway).
+    T, B = (20, 256) if tpu_ok else (20, 32)
     num_actions = 6  # Pong
     log(f"bench: backend={jax.default_backend()} T={T} B={B}")
 
@@ -80,7 +133,7 @@ def main() -> None:
     jax.block_until_ready(logs)
     log(f"bench: compiled, total_loss={float(logs['total_loss']):.3f}")
 
-    steps = 30
+    steps = 30 if tpu_ok else 5
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, pa, logs = learner._train_step(
@@ -97,13 +150,36 @@ def main() -> None:
         "value": round(value, 1),
         "unit": "frames/s/chip",
         "vs_baseline": round(value / 62_500.0, 3),
+        "backend": jax.default_backend(),
     }
+    if not tpu_ok:
+        result["note"] = (
+            "TPU tunnel unreachable at bench time; CPU fallback number — "
+            "not comparable to the 62.5k/chip TPU yardstick"
+        )
     log(
         f"bench: {steps} steps in {dt:.3f}s -> {frames_per_sec:,.0f} frames/s "
-        f"on {n_chips} chip(s)"
+        f"on {n_chips} {jax.default_backend()} device(s)"
     )
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # still emit ONE parseable JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "learner_frames_per_sec_per_chip_pong",
+                    "value": 0.0,
+                    "unit": "frames/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            )
+        )
+        sys.exit(1)
