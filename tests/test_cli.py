@@ -21,15 +21,17 @@ BASELINE = json.loads(
 class TestRegistry:
     def test_one_preset_per_baseline_config(self):
         # BASELINE.json:6-12 lists five configs; the registry must cover
-        # cartpole/pong/breakout/procgen/dmlab30.
+        # cartpole/pong/breakout/procgen/dmlab30 (plus experimental extras
+        # like the transformer-core preset).
         assert len(BASELINE["configs"]) == 5
-        assert set(configs.REGISTRY) == {
+        assert set(configs.REGISTRY) >= {
             "cartpole",
             "pong",
             "breakout",
             "procgen",
             "dmlab30",
         }
+        assert "pong_transformer" in configs.REGISTRY
 
     @pytest.mark.parametrize("name", sorted(
         ["cartpole", "pong", "breakout", "procgen", "dmlab30"]
@@ -85,6 +87,26 @@ class TestCLI:
         assert len(lines) >= 1
         last = json.loads(lines[-1])
         assert np.isfinite(last["total_loss"])
+
+    def test_pong_transformer_train_smoke(self, tmp_path):
+        # The transformer temporal core reached from the product surface
+        # (VERDICT r1 item 7): preset -> make_agent -> train, fake envs.
+        rc = cli_main([
+            "--config", "pong_transformer",
+            "--fake-envs",
+            "--total-steps", "2",
+            "--num-actors", "2",
+            "--batch-size", "2",
+            "--unroll-length", "4",
+            "--log-every", "1",
+            "--logger", "jsonl",
+            "--logdir", str(tmp_path),
+        ])
+        assert rc == 0
+        lines = (
+            tmp_path / "pong_transformer.jsonl"
+        ).read_text().splitlines()
+        assert np.isfinite(json.loads(lines[-1])["total_loss"])
 
     def test_train_checkpoint_then_eval(self, tmp_path):
         ck = str(tmp_path / "ck")

@@ -55,6 +55,16 @@ class ExperimentConfig:
     model: str = "mlp"  # mlp | shallow_cnn | deep_resnet
     use_lstm: bool = False
     lstm_size: int = 256
+    # Temporal core: "auto" resolves to lstm/none via use_lstm; "transformer"
+    # selects the sliding-window-KV causal core (models/transformer.py).
+    core: str = "auto"
+    transformer_d_model: int = 256
+    transformer_layers: int = 2
+    transformer_heads: int = 4
+    transformer_window: int = 128
+    # Atari preprocessing options (standard DeepMind stack extras).
+    episodic_life: bool = False
+    fire_reset: bool = False
     # Torso compute dtype ("float32" | "bfloat16"). bf16 keeps the conv
     # FLOPs on the MXU's fast path; params, LSTM core, heads, and all loss
     # math stay float32.
@@ -109,7 +119,14 @@ def make_agent(cfg: ExperimentConfig) -> Agent:
         num_actions=cfg.num_actions,
         torso=torso,
         use_lstm=cfg.use_lstm,
+        core=cfg.core,
         lstm_size=cfg.lstm_size,
+        transformer=(
+            ("d_model", cfg.transformer_d_model),
+            ("num_layers", cfg.transformer_layers),
+            ("num_heads", cfg.transformer_heads),
+            ("window", cfg.transformer_window),
+        ),
         num_values=cfg.num_tasks,
     )
     return Agent(net)
@@ -157,10 +174,23 @@ def example_obs(cfg: ExperimentConfig) -> np.ndarray:
 
 def make_env_factory(
     cfg: ExperimentConfig, *, fake: bool = False
-) -> Callable[[int], object]:
-    """seed -> env. `fake=True` substitutes shape-faithful fakes for env
-    families whose emulators aren't installed (throughput/integration runs
-    on any host). Multi-task presets round-robin tasks over seeds."""
+) -> Callable[..., object]:
+    """(seed, env_index=None) -> env. `fake=True` substitutes shape-faithful
+    fakes for env families whose emulators aren't installed
+    (throughput/integration runs on any host).
+
+    Multi-task presets assign `task = env_index % num_tasks`: the explicit
+    env index (global env slot, passed by the runtime) guarantees every task
+    is instantiated. Deriving tasks from the seed is WRONG — the runtime
+    strides seeds by 1000 per actor and gcd(1000, num_tasks) > 1 silently
+    drops tasks (round-1 advisor finding). The seed fallback exists only for
+    legacy single-task callers.
+    """
+
+    def task_of(seed: int, env_index) -> int:
+        idx = env_index if env_index is not None else seed
+        return idx % max(1, cfg.num_tasks)
+
     if fake:
         from torched_impala_tpu.envs.fake import (
             FakeAtariEnv,
@@ -180,18 +210,18 @@ def make_env_factory(
                 FakeAtariEnv if shape == (84, 84, 4) else _ShapedPixels
             )
 
-            def fake_factory(seed: int):
+            def fake_factory(seed: int, env_index=None):
                 env = pixel_cls(num_actions=cfg.num_actions, seed=seed)
-                env.task_id = seed % max(1, cfg.num_tasks)
+                env.task_id = task_of(seed, env_index)
                 return env
 
         else:
 
-            def fake_factory(seed: int):
+            def fake_factory(seed: int, env_index=None):
                 return FakeDiscreteEnv(
                     obs_shape=cfg.obs_shape,
                     num_actions=cfg.num_actions,
-                    task_id=seed % max(1, cfg.num_tasks),
+                    task_id=task_of(seed, env_index),
                     seed=seed,
                 )
 
@@ -201,11 +231,21 @@ def make_env_factory(
 
     family = FACTORIES[cfg.env_family]
 
-    def factory(seed: int):
+    def factory(seed: int, env_index=None):
+        task = task_of(seed, env_index)
         if cfg.env_family == "cartpole":
             env, _, _ = family(seed=seed)
+        elif cfg.env_family == "atari":
+            env, _, _ = family(
+                cfg.env_id,
+                seed=seed,
+                task=task,
+                episodic_life=cfg.episodic_life,
+                fire_reset=cfg.fire_reset,
+            )
         else:
-            env, _, _ = family(cfg.env_id, seed=seed)
+            env, _, _ = family(cfg.env_id, seed=seed, task=task)
+        env.task_id = task
         return env
 
     return factory
@@ -236,6 +276,8 @@ PONG = ExperimentConfig(
     num_actions=6,
     model="shallow_cnn",
     compute_dtype="bfloat16",
+    episodic_life=True,
+    fire_reset=True,
     num_actors=32,
     unroll_length=20,
     batch_size=32,
@@ -251,6 +293,8 @@ BREAKOUT = ExperimentConfig(
     num_actions=4,
     model="deep_resnet",
     compute_dtype="bfloat16",
+    episodic_life=True,
+    fire_reset=True,
     use_lstm=True,
     num_actors=256,
     unroll_length=20,
@@ -291,6 +335,40 @@ DMLAB30 = ExperimentConfig(
     total_env_frames=10_000_000_000,
 )
 
+# Experimental (beyond the five BASELINE presets): the transformer temporal
+# core on Pong shapes — exercises core="transformer" end-to-end
+# (models/transformer.py; VERDICT round 1 item 7). Runs with --fake-envs on
+# emulator-less hosts like any Atari preset.
+PONG_TRANSFORMER = ExperimentConfig(
+    name="pong_transformer",
+    env_family="atari",
+    env_id="PongNoFrameskip-v4",
+    obs_shape=(84, 84, 4),
+    obs_dtype="uint8",
+    num_actions=6,
+    model="shallow_cnn",
+    compute_dtype="bfloat16",
+    episodic_life=True,
+    fire_reset=True,
+    core="transformer",
+    transformer_d_model=256,
+    transformer_layers=2,
+    transformer_heads=4,
+    transformer_window=128,
+    num_actors=32,
+    unroll_length=20,
+    batch_size=32,
+    total_env_frames=200_000_000,
+)
+
 REGISTRY: dict[str, ExperimentConfig] = {
-    c.name: c for c in (CARTPOLE, PONG, BREAKOUT, PROCGEN, DMLAB30)
+    c.name: c
+    for c in (
+        CARTPOLE,
+        PONG,
+        BREAKOUT,
+        PROCGEN,
+        DMLAB30,
+        PONG_TRANSFORMER,
+    )
 }

@@ -2,14 +2,17 @@
 
 Capability parity with the reference's env stack (SURVEY.md §1 item 5):
 gym/ALE Atari behind the standard DeepMind wrapper set (frameskip/max-pool,
-grayscale, 84x84 resize, frame-stack, reward clip), CartPole, Procgen,
-DMLab-30. On hosts without the emulators (this machine has gymnasium only,
-SURVEY.md Appendix B) the Atari/Procgen/DMLab factories raise a clear
-ImportError at *call* time while the rest of the framework stays importable;
-fakes from `envs.fake` stand in for tests and benches.
+grayscale, 84x84 resize, frame-stack, reward clip, optional episodic-life and
+fire-reset), CartPole, Procgen, DMLab-30. On hosts without the emulators
+(this machine has gymnasium only, SURVEY.md Appendix B) the
+Atari/Procgen/DMLab factories raise a clear ImportError at *call* time while
+the rest of the framework stays importable; fakes from `envs.fake` stand in
+for tests and benches.
 
 Every factory returns `(env, num_actions, example_obs)` so callers never
-poke gymnasium spaces directly.
+poke gymnasium spaces directly. Multi-task families (DMLab-30) take an
+explicit `task` index — task selection must NOT be derived from the seed
+(seed strides can alias task ids; round-1 advisor finding).
 """
 
 from __future__ import annotations
@@ -30,7 +33,7 @@ class EnvSpec:
     obs_dtype: np.dtype
 
 
-def make_cartpole(seed: int = 0):
+def make_cartpole(seed: int = 0, task: int = 0):
     import gymnasium
 
     env = gymnasium.make("CartPole-v1")
@@ -41,10 +44,19 @@ def make_atari(
     env_id: str = "PongNoFrameskip-v4",
     *,
     seed: int = 0,
+    task: int = 0,
     frame_stack: int = 4,
     reward_clip: bool = True,
+    episodic_life: bool = False,
+    fire_reset: bool = False,
 ):
     """ALE Atari with the DeepMind preprocessing stack.
+
+    `episodic_life` reports life loss as episode termination (value
+    bootstrapping stops at each life) while only truly resetting the game
+    when it is over; `fire_reset` presses FIRE after each reset for games
+    that need it to start (no-op for games without a FIRE action). Both are
+    standard DeepMind-stack options.
 
     Requires ale-py (not installed on all hosts — raises ImportError with
     instructions rather than failing at import of this module).
@@ -70,36 +82,247 @@ def make_atari(
     env = gymnasium.wrappers.FrameStackObservation(env, frame_stack)
     if reward_clip:
         env = gymnasium.wrappers.TransformReward(env, np.sign)
-    # Outermost: plain-class transpose (not a gymnasium.Wrapper, so it must
+    # Outermost: plain-class wrappers (not gymnasium.Wrapper, so they must
     # come after every gymnasium wrapper in the stack).
+    if episodic_life:
+        env = EpisodicLife(env)
+    if fire_reset:
+        env = FireReset(env)
     env = TransposeFrameStack(env)
     n = env.action_space.n
     return env, n, np.zeros((84, 84, frame_stack), np.uint8)
 
 
-def make_procgen(env_name: str = "coinrun", *, seed: int = 0):
+def make_procgen(
+    env_name: str = "coinrun",
+    *,
+    seed: int = 0,
+    task: int = 0,
+    num_levels: int = 0,
+    start_level: int = 0,
+    distribution_mode: str = "hard",
+):
+    """Procgen via the legacy-gym registration the procgen package ships.
+
+    procgen registers old-gym (`gym`, 4-tuple step) envs; `GymV21Adapter`
+    lifts them to the gymnasium 5-tuple API the runtime speaks. All procgen
+    games share a 15-action space and (64, 64, 3) uint8 observations.
+    """
     try:
-        import procgen  # noqa: F401
+        import procgen  # noqa: F401 — registers the envs on import
+        import gym as legacy_gym
     except ImportError as e:
         raise ImportError(
-            "Procgen configs need the procgen package (not on this host)."
+            "Procgen configs need the procgen package (not on this host). "
+            "Use `--fake-envs` for shape/throughput work."
         ) from e
-    raise NotImplementedError(
-        "procgen wiring lands when the dependency is available"
+    env = legacy_gym.make(
+        f"procgen:procgen-{env_name}-v0",
+        rand_seed=seed,
+        num_levels=num_levels,
+        start_level=start_level,
+        distribution_mode=distribution_mode,
     )
+    env = GymV21Adapter(env)
+    return env, 15, np.zeros((64, 64, 3), np.uint8)
 
 
-def make_dmlab(level: str, *, seed: int = 0):
-    raise ImportError("DMLab configs need deepmind_lab (not on this host).")
+# The 30 levels of the DMLab-30 suite (public level names, under
+# contributed/dmlab30/ in the deepmind_lab assets).
+DMLAB30_LEVELS = (
+    "rooms_collect_good_objects_train",
+    "rooms_exploit_deferred_effects_train",
+    "rooms_select_nonmatching_object",
+    "rooms_watermaze",
+    "rooms_keys_doors_puzzle",
+    "language_select_described_object",
+    "language_select_located_object",
+    "language_execute_random_task",
+    "language_answer_quantitative_question",
+    "lasertag_one_opponent_small",
+    "lasertag_three_opponents_small",
+    "lasertag_one_opponent_large",
+    "lasertag_three_opponents_large",
+    "natlab_fixed_large_map",
+    "natlab_varying_map_regrowth",
+    "natlab_varying_map_randomized",
+    "skymaze_irreversible_path_hard",
+    "skymaze_irreversible_path_varied",
+    "psychlab_arbitrary_visuomotor_mapping",
+    "psychlab_continuous_recognition",
+    "psychlab_sequential_comparison",
+    "psychlab_visual_search",
+    "explore_object_locations_small",
+    "explore_object_locations_large",
+    "explore_obstructed_goals_small",
+    "explore_obstructed_goals_large",
+    "explore_goal_locations_small",
+    "explore_goal_locations_large",
+    "explore_object_rewards_few",
+    "explore_object_rewards_many",
+)
+
+# Discretized DMLab action set: 15 composite actions over the 7-dim raw
+# action space (look yaw, look pitch, strafe, move, fire, jump, crouch).
+# Covers the common IMPALA-style navigation+fire set plus vertical look,
+# jump, and crouch; length must match the dmlab30 preset's num_actions.
+DMLAB_ACTION_SET = (
+    (0, 0, 0, 1, 0, 0, 0),      # forward
+    (0, 0, 0, -1, 0, 0, 0),     # backward
+    (0, 0, -1, 0, 0, 0, 0),     # strafe left
+    (0, 0, 1, 0, 0, 0, 0),      # strafe right
+    (-20, 0, 0, 0, 0, 0, 0),    # look left
+    (20, 0, 0, 0, 0, 0, 0),     # look right
+    (-20, 0, 0, 1, 0, 0, 0),    # forward + look left
+    (20, 0, 0, 1, 0, 0, 0),     # forward + look right
+    (0, -10, 0, 0, 0, 0, 0),    # look down
+    (0, 10, 0, 0, 0, 0, 0),     # look up
+    (0, 0, 0, 0, 1, 0, 0),      # fire
+    (0, 0, 0, 1, 1, 0, 0),      # forward + fire
+    (0, 0, 0, 0, 0, 1, 0),      # jump
+    (0, 0, 0, 0, 0, 0, 1),      # crouch
+    (0, 0, 0, 0, 0, 0, 0),      # no-op
+)
 
 
-class TransposeFrameStack:
-    """gymnasium FrameStackObservation yields [stack, H, W]; the conv torsos
-    expect channel-last [H, W, stack]."""
+def make_dmlab(
+    level: str = "dmlab30",
+    *,
+    seed: int = 0,
+    task: int = 0,
+    width: int = 96,
+    height: int = 72,
+    frame_skip: int = 4,
+):
+    """DMLab behind the deepmind_lab native API.
+
+    `level="dmlab30"` selects `DMLAB30_LEVELS[task % 30]` — the multi-task
+    suite keyed by the explicit task index; any other value is used as a
+    literal level name. Observations are (height, width, 3) uint8 RGB;
+    actions are the 15-way discretization above.
+    """
+    try:
+        import deepmind_lab
+    except ImportError as e:
+        raise ImportError(
+            "DMLab configs need deepmind_lab (not on this host). "
+            "Use `--fake-envs` for shape/throughput work."
+        ) from e
+    if level == "dmlab30":
+        level = "contributed/dmlab30/" + DMLAB30_LEVELS[
+            task % len(DMLAB30_LEVELS)
+        ]
+    lab = deepmind_lab.Lab(
+        level,
+        ["RGB_INTERLEAVED"],
+        config={"width": str(width), "height": str(height)},
+    )
+    env = DMLabAdapter(lab, DMLAB_ACTION_SET, frame_skip=frame_skip, seed=seed)
+    return env, len(DMLAB_ACTION_SET), np.zeros((height, width, 3), np.uint8)
+
+
+class _Space:
+    """Minimal discrete action space stand-in (`.n`) for adapters."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+
+class GymV21Adapter:
+    """Old-gym (reset()->obs, 4-tuple step) -> gymnasium 5-tuple API."""
+
+    def __init__(self, env):
+        self._env = env
+        self.action_space = _Space(env.action_space.n)
+
+    @property
+    def unwrapped(self):
+        return getattr(self._env, "unwrapped", self._env)
+
+    def reset(self, **kw):
+        # Old gym takes seeding via env.seed(); procgen via rand_seed at
+        # construction. Ignore gymnasium-style reset kwargs it can't take.
+        obs = self._env.reset()
+        return np.asarray(obs), {}
+
+    def step(self, action):
+        obs, reward, done, info = self._env.step(action)
+        truncated = bool(info.get("TimeLimit.truncated", False))
+        terminated = bool(done) and not truncated
+        return np.asarray(obs), reward, terminated, truncated, info
+
+    def close(self):
+        self._env.close()
+
+
+class DMLabAdapter:
+    """deepmind_lab.Lab -> gymnasium 5-tuple API with a discrete action set."""
+
+    def __init__(self, lab, action_set, *, frame_skip: int = 4, seed: int = 0):
+        self._lab = lab
+        self._action_set = [np.asarray(a, dtype=np.intc) for a in action_set]
+        self._frame_skip = frame_skip
+        self._seed = seed
+        self._episode = 0
+        self._last_obs = None
+        self.action_space = _Space(len(action_set))
+
+    @property
+    def unwrapped(self):
+        return self._lab
+
+    def _obs(self):
+        return np.asarray(self._lab.observations()["RGB_INTERLEAVED"])
+
+    def reset(self, *, seed=None, **kw):
+        if seed is not None:
+            self._seed = seed
+        self._episode += 1
+        self._lab.reset(seed=self._seed + self._episode)
+        self._last_obs = self._obs()
+        return self._last_obs, {}
+
+    def step(self, action):
+        raw = self._action_set[int(action)]
+        reward = self._lab.step(raw, num_steps=self._frame_skip)
+        terminated = not self._lab.is_running()
+        if not terminated:
+            self._last_obs = self._obs()
+        # DMLab has no truncation signal; episodes end by the level timer,
+        # which the suite treats as termination.
+        return self._last_obs, float(reward), terminated, False, {}
+
+    def close(self):
+        self._lab.close()
+
+
+class _Delegating:
+    """Base for plain-class (non-gymnasium) wrappers: delegate everything
+    the runtime touches; subclasses override reset/step."""
 
     def __init__(self, env):
         self._env = env
         self.action_space = env.action_space
+
+    @property
+    def unwrapped(self):
+        return getattr(self._env, "unwrapped", self._env)
+
+    def reset(self, **kw):
+        return self._env.reset(**kw)
+
+    def step(self, action):
+        return self._env.step(action)
+
+    def close(self):
+        close = getattr(self._env, "close", None)
+        if close is not None:
+            close()
+
+
+class TransposeFrameStack(_Delegating):
+    """gymnasium FrameStackObservation yields [stack, H, W]; the conv torsos
+    expect channel-last [H, W, stack]."""
 
     def reset(self, **kw):
         obs, info = self._env.reset(**kw)
@@ -108,6 +331,68 @@ class TransposeFrameStack:
     def step(self, action):
         obs, r, term, trunc, info = self._env.step(action)
         return np.moveaxis(np.asarray(obs), 0, -1), r, term, trunc, info
+
+
+class EpisodicLife(_Delegating):
+    """Report life loss as episode termination; only truly reset the game
+    when it is over. Value bootstrapping then stops at each lost life (the
+    standard DeepMind-stack trick), while the emulator keeps its state."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        self._lives = 0
+        self._real_done = True
+
+    def _get_lives(self) -> int:
+        ale = getattr(self.unwrapped, "ale", None)
+        return int(ale.lives()) if ale is not None else 0
+
+    def reset(self, **kw):
+        if self._real_done:
+            obs, info = self._env.reset(**kw)
+        else:
+            # Life lost but game alive: advance one no-op step instead of
+            # resetting the emulator.
+            obs, _, term, trunc, info = self._env.step(0)
+            if term or trunc:
+                obs, info = self._env.reset(**kw)
+        self._real_done = False
+        self._lives = self._get_lives()
+        return obs, info
+
+    def step(self, action):
+        obs, r, term, trunc, info = self._env.step(action)
+        self._real_done = bool(term or trunc)
+        lives = self._get_lives()
+        if 0 < lives < self._lives:
+            term = True
+        self._lives = lives
+        return obs, r, term, trunc, info
+
+
+class FireReset(_Delegating):
+    """Press FIRE after reset for games that require it to start. No-op for
+    games whose action set has no FIRE."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        u = self.unwrapped
+        meanings = (
+            u.get_action_meanings()
+            if hasattr(u, "get_action_meanings")
+            else []
+        )
+        self._fire = meanings.index("FIRE") if "FIRE" in meanings else None
+
+    def reset(self, **kw):
+        obs, info = self._env.reset(**kw)
+        if self._fire is not None:
+            obs2, _, term, trunc, info2 = self._env.step(self._fire)
+            if term or trunc:
+                obs, info = self._env.reset(**kw)
+            else:
+                obs, info = obs2, info2
+        return obs, info
 
 
 FACTORIES: dict[str, Callable] = {

@@ -69,6 +69,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--eval-episodes", type=int, default=10)
     p.add_argument("--eval-stochastic", action="store_true",
                    help="sample actions instead of argmax")
+    p.add_argument("--eval-max-steps", type=int, default=108_000,
+                   help="per-episode env-step cap during eval (guards "
+                        "against never-terminating policies); <=0 disables")
     # Profiling (SURVEY.md §6 tracing row).
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the learner loop")
@@ -155,8 +158,10 @@ def main(argv=None) -> int:
 
         inner_factory = env_factory
 
-        def env_factory(seed: int):  # noqa: F811 — deliberate wrap
-            return CrashingEnv(inner_factory(seed), crash_after=args.chaos)
+        def env_factory(seed: int, env_index=None):  # noqa: F811
+            return CrashingEnv(
+                inner_factory(seed, env_index), crash_after=args.chaos
+            )
 
     total_steps = (
         args.total_steps
@@ -256,6 +261,9 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
         num_episodes=args.eval_episodes,
         greedy=not args.eval_stochastic,
         seed=args.seed,
+        max_steps_per_episode=(
+            args.eval_max_steps if args.eval_max_steps > 0 else None
+        ),
     )
     print(
         f"eval: episodes={len(result.returns)} "

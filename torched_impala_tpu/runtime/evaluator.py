@@ -55,12 +55,16 @@ def run_episodes(
     num_episodes: int,
     greedy: bool = True,
     seed: int = 0,
-    max_steps_per_episode: Optional[int] = None,
+    max_steps_per_episode: Optional[int] = 108_000,
 ) -> EvalResult:
     """Play `num_episodes` full episodes; returns per-episode stats.
 
     `greedy=True` takes argmax actions (the deterministic eval protocol);
     `greedy=False` samples from the policy (matches training behaviour).
+
+    `max_steps_per_episode` defaults to 108k env steps (the standard Atari
+    30-minute cap) so a never-terminating policy or non-truncating env can't
+    hang eval forever; pass None to remove the cap.
     """
     step_fn = _jitted_eval_step(agent, greedy)
     key = jax.random.key(seed)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import inspect
 import sys
 import threading
 from typing import Any, Callable, Mapping, Optional
@@ -86,6 +87,10 @@ def train(
             episode_returns.append((actor_id, ret, length))
 
     step_logs: dict = {}
+    # Bound after the Learner exists (the supervisor needs the learner's
+    # queue); the logger callback may fire before then (e.g. on resume), so
+    # guard the reference instead of closing over an unbound name.
+    supervisor: Optional[ActorSupervisor] = None
 
     def learner_logger(logs: Mapping[str, Any]) -> None:
         # Called by the learner every `log_interval` steps with host floats.
@@ -100,7 +105,9 @@ def train(
             merged["episode_return_mean"] = (
                 float(np.mean(recent)) if recent else float("nan")
             )
-            merged["actor_restarts"] = supervisor.restarts
+            merged["actor_restarts"] = (
+                supervisor.restarts if supervisor is not None else 0
+            )
             logger(merged)
 
     learner = Learner(
@@ -135,6 +142,22 @@ def train(
 
     stop_event = threading.Event()
 
+    # Factories that accept (seed, env_index) get the global env slot so
+    # multi-task families can cover every task — task selection must NOT be
+    # derived from the seed (seeds stride by 1000 per actor, and
+    # gcd(1000, num_tasks) > 1 silently drops tasks).
+    try:
+        _factory_takes_index = (
+            len(inspect.signature(env_factory).parameters) >= 2
+        )
+    except (TypeError, ValueError):
+        _factory_takes_index = False
+
+    def build_env(seed_: int, env_index: int):
+        if _factory_takes_index:
+            return env_factory(seed_, env_index)
+        return env_factory(seed_)
+
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the
         # published params, so restart-after-crash just rebuilds the envs.
@@ -152,11 +175,12 @@ def train(
         if envs_per_actor > 1:
             return VectorActor(
                 envs=[
-                    env_factory(base_seed + j) for j in range(envs_per_actor)
+                    build_env(base_seed + j, slot * envs_per_actor + j)
+                    for j in range(envs_per_actor)
                 ],
                 **common,
             )
-        return Actor(env=env_factory(base_seed), **common)
+        return Actor(env=build_env(base_seed, slot), **common)
 
     def on_restart(slot: int, error: BaseException) -> None:
         # stderr, not the metrics logger: this runs on the monitor thread.
