@@ -1,0 +1,176 @@
+"""ProcessEnvPool tests: multiprocess env workers (VERDICT r1 item 3).
+
+Factories here are module-level so they pickle across the spawn boundary.
+The pool's contract: same trajectory semantics as in-process envs, plus
+worker-crash repair. The equivalence test pins that contract exactly — a
+pooled VectorActor must emit bit-identical trajectories to a thread-mode
+VectorActor over the same deterministic envs.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu.envs.fake import (
+    CrashingFactory,
+    FakeDiscreteEnv,
+    ScriptedEnv,
+)
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
+from torched_impala_tpu.runtime.learner import LearnerConfig
+from torched_impala_tpu.runtime.loop import train
+from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.runtime.vector_actor import VectorActor
+
+
+def scripted_factory(seed: int, env_index=None):
+    env = ScriptedEnv(episode_len=5)
+    env.task_id = 0 if env_index is None else env_index
+    return env
+
+
+def discrete_factory(seed: int, env_index=None):
+    return FakeDiscreteEnv(obs_shape=(4,), num_actions=2, seed=seed)
+
+
+def make_pool(num_workers=2, envs_per_worker=3, factory=scripted_factory,
+              **kw):
+    return ProcessEnvPool(
+        env_factory=factory,
+        num_workers=num_workers,
+        envs_per_worker=envs_per_worker,
+        obs_shape=(4,),
+        obs_dtype=np.float32,
+        **kw,
+    )
+
+
+class TestProcessEnvPool:
+    def test_reset_step_episode_cycle(self):
+        pool = make_pool()
+        try:
+            obs = pool.reset_all()
+            assert obs.shape == (6, 4) and obs.dtype == np.float32
+            # ScriptedEnv obs[0] is the in-episode step counter.
+            np.testing.assert_array_equal(obs[:, 0], 0)
+            all_events = []
+            for t in range(1, 6):
+                obs, rewards, dones, events = pool.step_all(np.zeros(6))
+                all_events += events
+                np.testing.assert_array_equal(rewards, 1.0)
+                if t < 5:
+                    assert not dones.any()
+                    np.testing.assert_array_equal(obs[:, 0], t)
+                else:
+                    # Episode end: workers auto-reset; obs is fresh.
+                    assert dones.all()
+                    np.testing.assert_array_equal(obs[:, 0], 0)
+            assert sorted(e[0] for e in all_events) == list(range(6))
+            assert all(ret == 5.0 and ln == 5 for _, ret, ln in all_events)
+        finally:
+            pool.close()
+
+    def test_task_ids_follow_env_index(self):
+        pool = make_pool()
+        try:
+            assert pool.task_ids == list(range(6))
+        finally:
+            pool.close()
+
+    def test_unpicklable_factory_rejected(self):
+        with pytest.raises(ValueError, match="picklable"):
+            make_pool(factory=lambda seed, idx=None: ScriptedEnv())
+
+    def test_worker_crash_is_repaired(self):
+        factory = CrashingFactory(scripted_factory, crash_after=7)
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, factory=factory,
+            max_restarts=10,
+        )
+        try:
+            pool.reset_all()
+            for _ in range(12):
+                obs, rewards, dones, _ = pool.step_all(np.zeros(4))
+                assert obs.shape == (4, 4)
+            assert pool.restarts >= 2  # both workers crashed at least once
+        finally:
+            pool.close()
+
+    def test_restart_budget_exhaustion_raises(self):
+        factory = CrashingFactory(scripted_factory, crash_after=2)
+        pool = make_pool(
+            num_workers=1, envs_per_worker=1, factory=factory,
+            max_restarts=1,
+        )
+        try:
+            pool.reset_all()
+            with pytest.raises(RuntimeError, match="budget"):
+                for _ in range(10):
+                    pool.step_all(np.zeros(1))
+        finally:
+            pool.close()
+
+
+class TestPooledVectorActor:
+    def test_pooled_matches_thread_trajectories(self):
+        """Same deterministic envs + same policy seed => bit-identical
+        trajectories from the pooled and in-process paths."""
+        agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+        params = agent.init_params(
+            __import__("jax").random.key(0), np.zeros((4,), np.float32)
+        )
+        store = ParamStore()
+        store.publish(0, params)
+
+        def collect(envs_arg):
+            out = []
+            actor = VectorActor(
+                actor_id=0,
+                envs=envs_arg,
+                agent=agent,
+                param_store=store,
+                enqueue=out.append,
+                unroll_length=7,
+                seed=123,
+            )
+            actor.unroll_and_push()
+            actor.unroll_and_push()
+            return out
+
+        pool = make_pool(num_workers=1, envs_per_worker=3)
+        try:
+            pooled = collect(pool)
+        finally:
+            pool.close()
+        local = collect([scripted_factory(0, i) for i in range(3)])
+
+        assert len(pooled) == len(local) == 6
+        for p, l in zip(pooled, local):
+            np.testing.assert_array_equal(p.obs, l.obs)
+            np.testing.assert_array_equal(p.actions, l.actions)
+            np.testing.assert_array_equal(p.rewards, l.rewards)
+            np.testing.assert_array_equal(p.first, l.first)
+            np.testing.assert_array_equal(p.cont, l.cont)
+            np.testing.assert_array_equal(
+                p.behaviour_logits, l.behaviour_logits
+            )
+
+    def test_train_process_mode_e2e(self):
+        agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+        result = train(
+            agent=agent,
+            env_factory=discrete_factory,
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(batch_size=2, unroll_length=4),
+            optimizer=optax.sgd(1e-3),
+            total_steps=3,
+            envs_per_actor=2,
+            actor_mode="process",
+            actor_device=None,
+            log_every=1,
+        )
+        assert result.learner.num_steps == 3
+        assert result.num_frames == 3 * 2 * 4
+        assert np.isfinite(result.final_logs.get("total_loss", np.nan))

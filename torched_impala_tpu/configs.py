@@ -69,10 +69,14 @@ class ExperimentConfig:
     # FLOPs on the MXU's fast path; params, LSTM core, heads, and all loss
     # math stay float32.
     compute_dtype: str = "float32"
-    # Scale. `num_actors` is actor *threads*; each steps `envs_per_actor`
-    # envs with one batched policy dispatch per timestep (VectorActor).
+    # Scale. `num_actors` is actor threads (actor_mode="thread") or env
+    # worker *processes* (actor_mode="process"); each steps
+    # `envs_per_actor` envs. Thread mode batches policy dispatch per actor
+    # (VectorActor); process mode escapes the GIL and batches inference
+    # over the whole pool (runtime/env_pool.py).
     num_actors: int = 4
     envs_per_actor: int = 1
+    actor_mode: str = "thread"
     unroll_length: int = 20
     batch_size: int = 8
     total_env_frames: int = 1_000_000
@@ -172,12 +176,12 @@ def example_obs(cfg: ExperimentConfig) -> np.ndarray:
     return np.zeros(cfg.obs_shape, np.dtype(cfg.obs_dtype))
 
 
-def make_env_factory(
-    cfg: ExperimentConfig, *, fake: bool = False
-) -> Callable[..., object]:
-    """(seed, env_index=None) -> env. `fake=True` substitutes shape-faithful
-    fakes for env families whose emulators aren't installed
-    (throughput/integration runs on any host).
+@dataclasses.dataclass(frozen=True)
+class _EnvFactory:
+    """Picklable (seed, env_index=None) -> env factory for one preset.
+
+    A module-level class (not a closure) so process-mode actors can ship it
+    across the multiprocessing spawn boundary (runtime/env_pool.py).
 
     Multi-task presets assign `task = env_index % num_tasks`: the explicit
     env index (global env slot, passed by the runtime) guarantees every task
@@ -187,52 +191,21 @@ def make_env_factory(
     legacy single-task callers.
     """
 
-    def task_of(seed: int, env_index) -> int:
+    cfg: ExperimentConfig
+    fake: bool
+
+    def _task_of(self, seed: int, env_index) -> int:
         idx = env_index if env_index is not None else seed
-        return idx % max(1, cfg.num_tasks)
+        return idx % max(1, self.cfg.num_tasks)
 
-    if fake:
-        from torched_impala_tpu.envs.fake import (
-            FakeAtariEnv,
-            FakeDiscreteEnv,
-        )
+    def __call__(self, seed: int, env_index=None):
+        cfg = self.cfg
+        task = self._task_of(seed, env_index)
+        if self.fake:
+            return self._fake(seed, task)
+        from torched_impala_tpu.envs import FACTORIES
 
-        if cfg.obs_dtype == "uint8":
-            shape = cfg.obs_shape
-
-            class _ShapedPixels(FakeAtariEnv):
-                def _obs(self):
-                    return self._rng.integers(
-                        0, 256, size=shape, dtype=np.uint8
-                    )
-
-            pixel_cls = (
-                FakeAtariEnv if shape == (84, 84, 4) else _ShapedPixels
-            )
-
-            def fake_factory(seed: int, env_index=None):
-                env = pixel_cls(num_actions=cfg.num_actions, seed=seed)
-                env.task_id = task_of(seed, env_index)
-                return env
-
-        else:
-
-            def fake_factory(seed: int, env_index=None):
-                return FakeDiscreteEnv(
-                    obs_shape=cfg.obs_shape,
-                    num_actions=cfg.num_actions,
-                    task_id=task_of(seed, env_index),
-                    seed=seed,
-                )
-
-        return fake_factory
-
-    from torched_impala_tpu.envs import FACTORIES
-
-    family = FACTORIES[cfg.env_family]
-
-    def factory(seed: int, env_index=None):
-        task = task_of(seed, env_index)
+        family = FACTORIES[cfg.env_family]
         if cfg.env_family == "cartpole":
             env, _, _ = family(seed=seed)
         elif cfg.env_family == "atari":
@@ -248,7 +221,44 @@ def make_env_factory(
         env.task_id = task
         return env
 
-    return factory
+    def _fake(self, seed: int, task: int):
+        from torched_impala_tpu.envs.fake import (
+            FakeAtariEnv,
+            FakeDiscreteEnv,
+        )
+
+        cfg = self.cfg
+        if cfg.obs_dtype == "uint8":
+            shape = cfg.obs_shape
+
+            class _ShapedPixels(FakeAtariEnv):
+                def _obs(self):
+                    return self._rng.integers(
+                        0, 256, size=shape, dtype=np.uint8
+                    )
+
+            pixel_cls = (
+                FakeAtariEnv if shape == (84, 84, 4) else _ShapedPixels
+            )
+            env = pixel_cls(num_actions=cfg.num_actions, seed=seed)
+            env.task_id = task
+            return env
+        return FakeDiscreteEnv(
+            obs_shape=cfg.obs_shape,
+            num_actions=cfg.num_actions,
+            task_id=task,
+            seed=seed,
+        )
+
+
+def make_env_factory(
+    cfg: ExperimentConfig, *, fake: bool = False
+) -> Callable[..., object]:
+    """(seed, env_index=None) -> env. `fake=True` substitutes shape-faithful
+    fakes for env families whose emulators aren't installed
+    (throughput/integration runs on any host). The returned factory is
+    picklable — required for `actor_mode="process"`."""
+    return _EnvFactory(cfg, fake)
 
 
 # ---- the five BASELINE.json presets ------------------------------------

@@ -122,6 +122,31 @@ class FakeDiscreteEnv:
         return self._obs(), reward, terminated, False, {}
 
 
+class CrashingFactory:
+    """Picklable env factory that wraps another factory's envs in
+    `CrashingEnv` — chaos mode for both thread and process actors."""
+
+    def __init__(self, inner, crash_after: int):
+        self.inner = inner
+        self.crash_after = crash_after
+
+    def __call__(self, seed: int, env_index=None):
+        import inspect
+
+        try:
+            takes_index = (
+                len(inspect.signature(self.inner).parameters) >= 2
+            )
+        except (TypeError, ValueError):
+            takes_index = False
+        env = (
+            self.inner(seed, env_index)
+            if takes_index
+            else self.inner(seed)
+        )
+        return CrashingEnv(env, crash_after=self.crash_after)
+
+
 class CrashingEnv:
     """Wraps another env and raises after `crash_after` total steps.
 

@@ -36,6 +36,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--envs-per-actor", type=int, default=None,
                    help="envs stepped per actor thread with one batched "
                         "policy dispatch per timestep")
+    p.add_argument("--actor-mode", choices=("thread", "process"),
+                   default=None,
+                   help="'process' runs env workers as OS processes "
+                        "(GIL escape) feeding one batched-inference actor")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--unroll-length", type=int, default=None)
     p.add_argument("--total-steps", type=int, default=None,
@@ -90,6 +94,7 @@ def build_config(args: argparse.Namespace):
     for flag, field in (
         ("num_actors", "num_actors"),
         ("envs_per_actor", "envs_per_actor"),
+        ("actor_mode", "actor_mode"),
         ("batch_size", "batch_size"),
         ("unroll_length", "unroll_length"),
         ("total_env_frames", "total_env_frames"),
@@ -154,14 +159,9 @@ def main(argv=None) -> int:
 
     env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
     if args.chaos:
-        from torched_impala_tpu.envs.fake import CrashingEnv
+        from torched_impala_tpu.envs.fake import CrashingFactory
 
-        inner_factory = env_factory
-
-        def env_factory(seed: int, env_index=None):  # noqa: F811
-            return CrashingEnv(
-                inner_factory(seed, env_index), crash_after=args.chaos
-            )
+        env_factory = CrashingFactory(env_factory, crash_after=args.chaos)
 
     total_steps = (
         args.total_steps
@@ -201,6 +201,7 @@ def main(argv=None) -> int:
             resume=args.resume,
             max_actor_restarts=args.max_actor_restarts,
             envs_per_actor=cfg.envs_per_actor,
+            actor_mode=cfg.actor_mode,
         )
     finally:
         if profile_ctx is not None:

@@ -1,0 +1,336 @@
+"""ProcessEnvPool: env stepping in worker processes, shared-memory returns.
+
+The reference's defining mechanism is actor *processes*
+(torch.multiprocessing + Queue, SURVEY.md §1 item 1): at 256-512 actors,
+env stepping must escape the GIL. The TPU-native shape of that idea is an
+env-worker pool feeding *central batched inference* (the SEED-RL
+decomposition): worker processes own the emulators and nothing else — they
+never import jax, never touch the (fragile, tunnel-backed) accelerator, and
+step E envs each behind a tiny pipe protocol, writing observations into a
+SharedMemory block the parent reads zero-copy. The parent-side
+`VectorActor` then batches policy inference over ALL pooled envs in one
+`[E_total, ...]` jit call and assembles per-env unrolls for the learner —
+trajectory and staleness semantics are unchanged from the thread path.
+
+Protocol (per worker, lockstep):
+  parent -> worker : ("step", actions[E] int32 list) | ("close",)
+  worker -> parent : ("stepped", rewards[E], dones[E], events)
+                     with next obs already written to shm; `events` is a
+                     list of (env_local_idx, episode_return, episode_len)
+                     completed this step. Workers auto-reset finished envs
+                     (envpool-style), so `dones` doubles as next-step
+                     `first` flags.
+  worker -> parent : ("error", repr) then exit — the pool respawns the
+                     process (envs are stateless up to the published
+                     params) and counts a restart.
+
+The env factory must be PICKLABLE (spawn start method): module-level
+functions, functools.partial of them, or `configs.make_env_factory`'s
+factory objects all work; lambdas/closures raise a clear error at pool
+construction.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import pickle
+import time
+from multiprocessing import shared_memory
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_CTX = mp.get_context("spawn")
+
+
+def _worker_main(
+    conn,
+    shm_name: str,
+    shm_offset: int,
+    factory_bytes: bytes,
+    num_envs: int,
+    base_seed: int,
+    first_env_index: int,
+    obs_shape: tuple,
+    obs_dtype_str: str,
+) -> None:
+    """Worker process body: build envs, then step on command.
+
+    Deliberately numpy-only: importing the factory may pull in jax as a
+    module, but no jax backend is ever initialized here — on this machine
+    backend init can hang machine-wide (axon tunnel), and workers must be
+    immune to that.
+    """
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        obs_dtype = np.dtype(obs_dtype_str)
+        nbytes = num_envs * int(np.prod(obs_shape)) * obs_dtype.itemsize
+        obs_block = np.ndarray(
+            (num_envs, *obs_shape),
+            dtype=obs_dtype,
+            buffer=shm.buf[shm_offset : shm_offset + nbytes],
+        )
+        factory = pickle.loads(factory_bytes)
+        try:
+            import inspect
+
+            takes_index = len(inspect.signature(factory).parameters) >= 2
+        except (TypeError, ValueError):
+            takes_index = False
+
+        def build(i: int):
+            if takes_index:
+                return factory(base_seed + i, first_env_index + i)
+            return factory(base_seed + i)
+
+        envs = [build(i) for i in range(num_envs)]
+        task_ids = [int(getattr(e, "task_id", 0)) for e in envs]
+        for i, env in enumerate(envs):
+            obs, _ = env.reset(seed=base_seed + i)
+            obs_block[i] = np.asarray(obs)
+        ep_return = np.zeros((num_envs,), np.float64)
+        ep_len = np.zeros((num_envs,), np.int64)
+        conn.send(("ready", task_ids))
+
+        while True:
+            msg = conn.recv()
+            if msg[0] == "close":
+                return
+            assert msg[0] == "step", msg
+            actions = msg[1]
+            rewards = np.empty((num_envs,), np.float32)
+            dones = np.empty((num_envs,), np.bool_)
+            events: List[Tuple[int, float, int]] = []
+            for i, env in enumerate(envs):
+                obs, reward, terminated, truncated, _ = env.step(
+                    int(actions[i])
+                )
+                done = bool(terminated or truncated)
+                rewards[i] = reward
+                dones[i] = done
+                ep_return[i] += float(reward)
+                ep_len[i] += 1
+                if done:
+                    events.append(
+                        (i, float(ep_return[i]), int(ep_len[i]))
+                    )
+                    ep_return[i] = 0.0
+                    ep_len[i] = 0
+                    obs, _ = env.reset()
+                obs_block[i] = np.asarray(obs)
+            conn.send(("stepped", rewards, dones, events))
+    except EOFError:
+        pass
+    except BaseException as e:  # noqa: BLE001 — must report, then die
+        try:
+            conn.send(("error", repr(e)))
+        except Exception:
+            pass
+    finally:
+        shm.close()
+
+
+class ProcessEnvPool:
+    """W worker processes x E envs each, presented as one batched env.
+
+    Surface consumed by `VectorActor`'s pooled path:
+      num_envs, task_ids, reset_all() -> obs[N], and
+      step_all(actions[N]) -> (obs[N], rewards[N], dones[N], events)
+    where `dones` are the next-step `first` flags (workers auto-reset) and
+    `events` is a list of (global_env_idx, episode_return, episode_len).
+    """
+
+    def __init__(
+        self,
+        *,
+        env_factory: Callable,
+        num_workers: int,
+        envs_per_worker: int,
+        obs_shape: Sequence[int],
+        obs_dtype,
+        base_seed: int = 0,
+        seed_stride: int = 1000,
+        max_restarts: int = 10,
+        step_timeout: float = 300.0,
+    ) -> None:
+        if num_workers < 1 or envs_per_worker < 1:
+            raise ValueError("need >= 1 worker and >= 1 env per worker")
+        try:
+            self._factory_bytes = pickle.dumps(env_factory)
+        except Exception as e:
+            raise ValueError(
+                "process actors need a picklable env factory (module-level "
+                "function, functools.partial, or configs.make_env_factory "
+                "output) — closures/lambdas cannot cross the spawn boundary"
+            ) from e
+        self._num_workers = num_workers
+        self._envs_per_worker = envs_per_worker
+        self._obs_shape = tuple(obs_shape)
+        self._obs_dtype = np.dtype(obs_dtype)
+        self._base_seed = base_seed
+        self._seed_stride = seed_stride
+        self._max_restarts = max_restarts
+        self._step_timeout = step_timeout
+        self.restarts = 0
+
+        n = num_workers * envs_per_worker
+        self._shm = shared_memory.SharedMemory(
+            create=True,
+            size=max(1, n * int(np.prod(self._obs_shape))
+                     * self._obs_dtype.itemsize),
+        )
+        self._obs_block = np.ndarray(
+            (n, *self._obs_shape), dtype=self._obs_dtype, buffer=self._shm.buf
+        )
+        self._procs: List[Optional[mp.Process]] = [None] * num_workers
+        self._conns: List = [None] * num_workers
+        self.task_ids: List[int] = [0] * n
+        self._closed = False
+        try:
+            for w in range(num_workers):
+                self._spawn(w)
+        except Exception:
+            self.close()
+            raise
+
+    # -- worker lifecycle --------------------------------------------------
+
+    def _worker_slice(self, w: int) -> slice:
+        E = self._envs_per_worker
+        return slice(w * E, (w + 1) * E)
+
+    def _spawn(self, w: int) -> None:
+        parent_conn, child_conn = _CTX.Pipe()
+        E = self._envs_per_worker
+        offset = (
+            w * E * int(np.prod(self._obs_shape)) * self._obs_dtype.itemsize
+        )
+        proc = _CTX.Process(
+            target=_worker_main,
+            args=(
+                child_conn,
+                self._shm.name,
+                offset,
+                self._factory_bytes,
+                E,
+                self._base_seed + self._seed_stride * (w + 1),
+                w * E,
+                self._obs_shape,
+                self._obs_dtype.str,
+            ),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        self._procs[w] = proc
+        self._conns[w] = parent_conn
+        msg = self._recv(w)
+        if msg[0] != "ready":
+            raise RuntimeError(f"env worker {w} failed to start: {msg!r}")
+        self.task_ids[self._worker_slice(w)] = msg[1]
+
+    def _recv(self, w: int):
+        conn = self._conns[w]
+        if not conn.poll(self._step_timeout):
+            raise TimeoutError(
+                f"env worker {w} did not respond within "
+                f"{self._step_timeout}s"
+            )
+        return conn.recv()
+
+    def _restart(self, w: int, reason: str) -> None:
+        if self.restarts >= self._max_restarts:
+            raise RuntimeError(
+                f"env worker {w} died ({reason}) and the pool restart "
+                f"budget ({self._max_restarts}) is spent"
+            )
+        self.restarts += 1
+        proc = self._procs[w]
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+        if proc is not None:
+            proc.join(timeout=10)
+        self._conns[w].close()
+        self._spawn(w)
+
+    # -- batched env surface ----------------------------------------------
+
+    @property
+    def num_envs(self) -> int:
+        return self._num_workers * self._envs_per_worker
+
+    def reset_all(self) -> np.ndarray:
+        """Initial observations. Workers reset at spawn, so this just reads
+        the shared block (also the recovery point after a restart)."""
+        return np.array(self._obs_block)  # copy out of the shared buffer
+
+    def step_all(self, actions: np.ndarray):
+        """Step every env once; returns (next_obs, rewards, dones, events).
+
+        Rows of `next_obs` for finished envs are fresh reset observations
+        and the matching `dones` entry is True (= next `first` flag).
+        Worker failures are repaired in-line: the dead worker is respawned,
+        its envs reset, its rows reported done with zero reward (the learner
+        sees a clean episode boundary, not a poisoned trajectory).
+        """
+        n = self.num_envs
+        rewards = np.zeros((n,), np.float32)
+        dones = np.zeros((n,), np.bool_)
+        events: List[Tuple[int, float, int]] = []
+        actions = np.asarray(actions, np.int32)
+        for w in range(self._num_workers):
+            sl = self._worker_slice(w)
+            self._conns[w].send(("step", actions[sl].tolist()))
+        for w in range(self._num_workers):
+            sl = self._worker_slice(w)
+            try:
+                msg = self._recv(w)
+                if msg[0] == "error":
+                    raise RuntimeError(f"env worker {w}: {msg[1]}")
+                _, w_rewards, w_dones, w_events = msg
+                rewards[sl] = w_rewards
+                dones[sl] = w_dones
+                base = sl.start
+                events.extend(
+                    (base + i, ret, length) for i, ret, length in w_events
+                )
+            except (EOFError, TimeoutError, RuntimeError) as e:
+                self._restart(w, repr(e))
+                # Fresh worker wrote reset obs; mark an episode boundary.
+                dones[sl] = True
+        return np.array(self._obs_block), rewards, dones, events
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for w in range(self._num_workers):
+            conn = self._conns[w]
+            if conn is not None:
+                try:
+                    conn.send(("close",))
+                except Exception:
+                    pass
+        deadline = time.monotonic() + 10
+        for proc in self._procs:
+            if proc is not None:
+                proc.join(timeout=max(0.1, deadline - time.monotonic()))
+                if proc.is_alive():
+                    proc.terminate()
+        for conn in self._conns:
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+        self._shm.close()
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -54,6 +54,7 @@ def train(
     resume: bool = False,
     max_actor_restarts: Optional[int] = 10,
     envs_per_actor: int = 1,
+    actor_mode: str = "thread",
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -71,7 +72,19 @@ def train(
     `checkpoint_interval` learner steps and at the end; `resume=True`
     restores the latest checkpoint before training (restoring the
     actor-visible param version too, SURVEY.md §6 checkpoint row).
+
+    `actor_mode` selects how env stepping escapes Python:
+    - "thread": `num_actors` actor threads in this process, each stepping
+      `envs_per_actor` envs (fine for tests and small runs; the GIL caps
+      env throughput at scale).
+    - "process": `num_actors` worker *processes* (runtime/env_pool.py),
+      each hosting `envs_per_actor` envs, feeding ONE batched-inference
+      actor thread — the reference's multiprocess-actor capability in its
+      TPU-native (central-inference) shape. Requires a picklable
+      `env_factory`.
     """
+    if actor_mode not in ("thread", "process"):
+        raise ValueError(f"unknown actor_mode {actor_mode!r}")
     device = None
     if actor_device is not None:
         try:
@@ -158,6 +171,24 @@ def train(
             return env_factory(seed_, env_index)
         return env_factory(seed_)
 
+    env_pool = None
+    if actor_mode == "process":
+        from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
+
+        env_pool = ProcessEnvPool(
+            env_factory=env_factory,
+            num_workers=num_actors,
+            envs_per_worker=envs_per_actor,
+            obs_shape=example_obs.shape,
+            obs_dtype=example_obs.dtype,
+            base_seed=seed,
+            max_restarts=(
+                max_actor_restarts * num_actors
+                if max_actor_restarts is not None
+                else 1_000_000
+            ),
+        )
+
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the
         # published params, so restart-after-crash just rebuilds the envs.
@@ -172,6 +203,11 @@ def train(
             on_episode_return=on_episode_return,
             device=device,
         )
+        if env_pool is not None:
+            # One batched-inference actor over the whole pool; the pool
+            # itself repairs dead workers, so a supervisor respawn of this
+            # actor just re-attaches to the live pool.
+            return VectorActor(envs=env_pool, **common)
         if envs_per_actor > 1:
             return VectorActor(
                 envs=[
@@ -193,7 +229,8 @@ def train(
 
     supervisor = ActorSupervisor(
         make_actor=make_actor,
-        num_actors=num_actors,
+        # Process mode runs ONE batched-inference thread over the pool.
+        num_actors=1 if env_pool is not None else num_actors,
         stop_event=stop_event,
         max_restarts_per_actor=max_actor_restarts,
         on_restart=on_restart,
@@ -230,6 +267,8 @@ def train(
         except Exception:
             pass
         supervisor.join()
+        if env_pool is not None:
+            env_pool.close()
 
     if checkpointer is not None:
         checkpointer.save(learner.num_steps, learner.get_state())
@@ -242,5 +281,6 @@ def train(
         final_logs=dict(step_logs),
         learner=learner,
         num_frames=learner.num_frames,
-        actor_restarts=supervisor.restarts,
+        actor_restarts=supervisor.restarts
+        + (env_pool.restarts if env_pool is not None else 0),
     )

@@ -68,11 +68,14 @@ class VectorActor:
     ) -> None:
         """`tasks` overrides the per-env task ids (default: each env's
         `task_id` attribute, else 0). `device` pins policy inference — see
-        `Actor` for the committed-inputs mechanism."""
-        if not envs:
-            raise ValueError("VectorActor needs at least one env")
+        `Actor` for the committed-inputs mechanism.
+
+        `envs` is either a sequence of gymnasium-API envs (thread path) or
+        a single batched-env object exposing
+        `num_envs / task_ids / reset_all / step_all` (a
+        `ProcessEnvPool` — env stepping then happens in worker processes
+        while this actor does batched inference and unroll assembly)."""
         self._id = actor_id
-        self._envs = list(envs)
         self._agent = agent
         self._param_store = param_store
         self._enqueue = enqueue
@@ -86,19 +89,34 @@ class VectorActor:
         self.error: Optional[BaseException] = None
         self.num_unrolls = 0  # counts emitted trajectories (E per cycle)
 
-        E = len(self._envs)
-        self._tasks = (
-            [int(t) for t in tasks]
-            if tasks is not None
-            else [int(getattr(e, "task_id", 0)) for e in self._envs]
-        )
+        if hasattr(envs, "step_all"):  # batched env (ProcessEnvPool)
+            self._pool = envs
+            self._envs = []
+            E = self._pool.num_envs
+            self._tasks = (
+                [int(t) for t in tasks]
+                if tasks is not None
+                else [int(t) for t in self._pool.task_ids]
+            )
+            self._obs = self._pool.reset_all()
+        else:
+            if not envs:
+                raise ValueError("VectorActor needs at least one env")
+            self._pool = None
+            self._envs = list(envs)
+            E = len(self._envs)
+            self._tasks = (
+                [int(t) for t in tasks]
+                if tasks is not None
+                else [int(getattr(e, "task_id", 0)) for e in self._envs]
+            )
+            obs0 = []
+            for i, env in enumerate(self._envs):
+                obs, _ = env.reset(seed=seed + i)
+                obs0.append(np.asarray(obs))
+            self._obs = np.stack(obs0)  # [E, ...]
         if len(self._tasks) != E:
             raise ValueError("tasks must have one entry per env")
-        obs0 = []
-        for i, env in enumerate(self._envs):
-            obs, _ = env.reset(seed=seed + i)
-            obs0.append(np.asarray(obs))
-        self._obs = np.stack(obs0)  # [E, ...]
         self._first = np.ones((E,), np.bool_)
         self._state = agent.initial_state(E)
         self._episode_return = np.zeros((E,), np.float64)
@@ -106,11 +124,13 @@ class VectorActor:
 
     @property
     def num_envs(self) -> int:
-        return len(self._envs)
+        return self._pool.num_envs if self._pool is not None else len(
+            self._envs
+        )
 
     def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
         """Step all E envs for T steps; return E single-env trajectories."""
-        T, E = self._unroll_length, len(self._envs)
+        T, E = self._unroll_length, self.num_envs
         if self._device is not None:
             params = jax.device_put(params, self._device)
         obs_buf = np.empty((T + 1, E, *self._obs.shape[1:]), self._obs.dtype)
@@ -138,6 +158,22 @@ class VectorActor:
                     (T, E, out.policy_logits.shape[-1]), np.float32
                 )
             logits_buf[t] = np.asarray(out.policy_logits)
+
+            if self._pool is not None:
+                # Env stepping happens in the worker processes; the pool
+                # auto-resets finished envs and reports completed episodes.
+                next_obs, step_rewards, dones, events = self._pool.step_all(
+                    acts
+                )
+                actions[t] = acts
+                rewards[t] = step_rewards
+                cont[t] = np.where(dones, 0.0, 1.0)
+                self._obs = next_obs
+                self._first = dones.copy()
+                if self._on_episode_return is not None:
+                    for _, ret, length in events:
+                        self._on_episode_return(self._id, ret, length)
+                continue
 
             # The host-side env loop: the only per-env Python work left.
             for i, env in enumerate(self._envs):
