@@ -237,6 +237,9 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
             "opt_state": configs.make_optimizer(cfg).init(params),
             "num_frames": np.asarray(0, np.int64),
             "num_steps": np.asarray(0, np.int64),
+            "rng": np.asarray(
+                jax.random.key_data(jax.random.key(args.seed))
+            ),
         }
         if cfg.num_tasks > 1:
             from torched_impala_tpu.ops import popart as popart_ops

@@ -135,6 +135,11 @@ class Learner:
                     f"value-head width {net_nv}; set ImpalaNet(num_values=K)"
                 )
 
+        # Kept (and checkpointed) so resumed runs re-derive any future
+        # learner-side sampling from the same stream; today init is its only
+        # consumer. Actor streams are derived from actor seeds at (re)start
+        # — see utils/checkpoint.py for the determinism story.
+        self._rng = rng
         self._params = agent.init_params(rng, jnp.asarray(example_obs))
         self._opt_state = optimizer.init(self._params)
         self._popart_state = (
@@ -463,11 +468,14 @@ class Learner:
         # Host snapshots, not live device refs: the train step donates the
         # params/opt_state buffers, so live refs would dangle after the next
         # step_once ("Array has been deleted").
+        from torched_impala_tpu.utils.checkpoint import pack_rng
+
         state = {
             "params": jax.tree.map(np.asarray, self._params),
             "opt_state": jax.tree.map(np.asarray, self._opt_state),
             "num_frames": np.asarray(self.num_frames, np.int64),
             "num_steps": np.asarray(self.num_steps, np.int64),
+            "rng": np.asarray(pack_rng(self._rng)),
         }
         # Only present under PopArt: keeps non-PopArt checkpoint trees
         # identical to pre-PopArt ones (orbax restore requires matching
@@ -508,6 +516,10 @@ class Learner:
         self._popart_state = popart_state
         self.num_frames = int(state["num_frames"])
         self.num_steps = int(state["num_steps"])
+        if "rng" in state:
+            from torched_impala_tpu.utils.checkpoint import unpack_rng
+
+            self._rng = unpack_rng(state["rng"])
         self._publish()
 
     # ---- introspection -------------------------------------------------

@@ -11,6 +11,19 @@ restore the actor-visible param version").
 PRNG keys: typed `jax.random.key` arrays are stored as their uint32
 `key_data` (orbax handles raw arrays; callers re-wrap with
 `jax.random.wrap_key_data` if they need a typed key back).
+
+Determinism story across resume:
+- the learner's `rng` stream is checkpointed and restored (today init is
+  its only consumer; any future stochastic learner op inherits resume
+  determinism for free);
+- actor sampling streams are NOT checkpointed: actors are stateless up to
+  the published params and re-derive their keys from their seeds at every
+  (re)start — crash-restart and resume share one code path. Two resumes of
+  the same checkpoint therefore produce identical action sequences
+  (deterministic envs + same seeds + same restored params; pinned by
+  tests/test_utils.py resume-determinism test); a resumed run is NOT a
+  bit-level continuation of where the original would have gone, which
+  async actor-learner timing makes impossible anyway.
 """
 
 from __future__ import annotations
