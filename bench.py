@@ -166,6 +166,24 @@ def run_bench(jax, tpu_ok: bool) -> None:
     jax.block_until_ready(logs)
     dt = time.perf_counter() - t0
 
+    trace_dir = None
+    if tpu_ok:
+        # SURVEY.md §6 tracing row: capture a real profiler trace of a few
+        # steady-state steps (outside the timed window) for MFU/infeed
+        # analysis; committed under traces/ for the round notes.
+        try:
+            trace_dir = os.path.join(REPO, "traces", "bench")
+            with jax.profiler.trace(trace_dir, create_perfetto_link=False):
+                for _ in range(5):
+                    params, opt_state, pa, logs = learner._train_step(
+                        params, opt_state, pa, *arrays
+                    )
+                jax.block_until_ready(logs)
+            log(f"bench: profiler trace captured in {trace_dir}")
+        except Exception as e:
+            log(f"bench: trace capture failed: {type(e).__name__}: {e}")
+            trace_dir = None
+
     frames_per_sec = T * B * steps / dt
     n_chips = max(1, len(jax.devices()))
     value = frames_per_sec / n_chips
@@ -180,6 +198,8 @@ def run_bench(jax, tpu_ok: bool) -> None:
         # production hosts with real core counts scale the env fleet.
         "host_cpus": os.cpu_count(),
     }
+    if trace_dir is not None:
+        result["profile_trace_dir"] = trace_dir
     try:
         # XLA's own FLOP count for the compiled train step -> rough MFU
         # against the v5e bf16 peak (197 TFLOP/s/chip). "Rough": XLA counts
