@@ -1,0 +1,101 @@
+"""Worker for tests/test_multihost.py: one 'host' of a 2-process learner.
+
+Launched twice (process_id 0 and 1). Each process gets 4 virtual CPU
+devices; jax.distributed joins them into one 8-device global mesh. Each
+host contributes its local half of the global batch; the donated pjit train
+step then runs as one SPMD program across both processes — the gradient
+all-reduce crosses the process boundary exactly the way it crosses hosts
+on a real pod. Both processes must print the identical global loss.
+
+Usage: python tests/multihost_learner_worker.py <process_id> <port>
+"""
+
+import os
+import sys
+
+# Scripts get their own dir (tests/) on sys.path, not the repo root; add it
+# (sys.path, not PYTHONPATH — PYTHONPATH breaks the axon plugin on this box).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# FORCE 4 devices per process, replacing any inherited count (pytest's
+# conftest exports ...device_count=8 into the environment it spawns from).
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=4"]
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    process_id, port = int(sys.argv[1]), int(sys.argv[2])
+
+    from torched_impala_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert multihost.process_count() == 2
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    import numpy as np
+    import optax
+
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.parallel import make_mesh
+    from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
+    from torched_impala_tpu.runtime.types import Trajectory
+
+    T, B_global = 5, 8
+    mesh = make_mesh(num_data=8)
+    agent = Agent(ImpalaNet(num_actions=3, torso=MLPTorso()))
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(batch_size=B_global, unroll_length=T),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+        mesh=mesh,
+    )
+    assert learner._local_batch_size == 4
+
+    # Each host contributes 4 deterministic, host-distinct unrolls.
+    for i in range(4):
+        rng = np.random.default_rng(1000 * process_id + i)
+        learner.enqueue(
+            Trajectory(
+                obs=rng.normal(size=(T + 1, 4)).astype(np.float32),
+                first=np.zeros((T + 1,), np.bool_),
+                actions=rng.integers(0, 3, size=(T,)).astype(np.int32),
+                behaviour_logits=rng.normal(size=(T, 3)).astype(np.float32),
+                rewards=rng.normal(size=(T,)).astype(np.float32),
+                cont=np.ones((T,), np.float32),
+                agent_state=(),
+                actor_id=process_id,
+                param_version=0,
+                task=0,
+            )
+        )
+    learner.start()
+    logs = learner.step_once(timeout=300)
+    learner.stop()
+    loss = float(logs["total_loss"])
+    assert np.isfinite(loss)
+    for leaf in jax.tree.leaves(learner.params):
+        assert leaf.sharding.is_fully_replicated
+    print(f"RESULT process={process_id} loss={loss:.10f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

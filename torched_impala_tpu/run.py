@@ -49,6 +49,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Parallelism.
     p.add_argument("--dp", type=int, default=None,
                    help="shard learner batch over N devices (-1 = all)")
+    p.add_argument("--coordinator", default=None,
+                   help="multi-host: coordinator host:port "
+                        "(jax.distributed); every host runs this same "
+                        "command with its own --host-id")
+    p.add_argument("--num-hosts", type=int, default=None)
+    p.add_argument("--host-id", type=int, default=None)
     # Environments.
     p.add_argument("--fake-envs", action="store_true",
                    help="substitute shape-faithful fake envs (no emulators)")
@@ -125,6 +131,14 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.coordinator or args.num_hosts or args.host_id is not None:
+        from torched_impala_tpu.parallel import multihost
+
+        multihost.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_hosts,
+            process_id=args.host_id,
+        )
     from torched_impala_tpu import configs
     from torched_impala_tpu.parallel import make_mesh
     from torched_impala_tpu.runtime.loop import train

@@ -40,6 +40,7 @@ from torched_impala_tpu.parallel.mesh import (
     replicated,
     state_sharding,
 )
+from torched_impala_tpu.parallel import multihost
 from torched_impala_tpu.runtime.param_store import ParamStore
 from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
 
@@ -125,6 +126,15 @@ class Learner:
                 f"batch_size {config.batch_size} not divisible by data axis "
                 f"{mesh.shape[DATA_AXIS]}"
             )
+        # Multi-host: batch_size is the GLOBAL batch; this host's batcher
+        # assembles its 1/process_count share and place_batch stitches the
+        # global sharded array (parallel/multihost.py). Single-host this is
+        # batch_size and a plain sharded device_put.
+        self._local_batch_size = (
+            multihost.local_batch_size(config.batch_size)
+            if mesh is not None
+            else config.batch_size
+        )
         if config.popart is not None:
             net_nv = agent.net.num_values
             if net_nv != config.popart.num_values:
@@ -297,7 +307,7 @@ class Learner:
             raise
 
     def _batcher_loop_impl(self) -> None:
-        B = self._config.batch_size
+        B = self._local_batch_size
         while not self._stop.is_set():
             trajs: list[Trajectory] = []
             while len(trajs) < B:
@@ -339,7 +349,11 @@ class Learner:
             if self._mesh is None:
                 on_device = jax.device_put(arrays)
             else:
-                on_device = jax.device_put(arrays, self._batch_shardings)
+                # Single-host: sharded device_put. Multi-host: this host's
+                # local slice becomes its shards of the global batch array.
+                on_device = multihost.place_batch(
+                    self._batch_shardings, arrays
+                )
             while True:
                 if self._stop.is_set():
                     return
