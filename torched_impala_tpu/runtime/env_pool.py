@@ -187,8 +187,13 @@ class ProcessEnvPool:
         self.task_ids: List[int] = [0] * n
         self._closed = False
         try:
+            # Start every worker before waiting on any: interpreter startup
+            # (sitecustomize imports jax) dominates spawn latency, so the
+            # ready-waits overlap instead of serializing.
             for w in range(num_workers):
-                self._spawn(w)
+                self._start(w)
+            for w in range(num_workers):
+                self._wait_ready(w)
         except Exception:
             self.close()
             raise
@@ -200,6 +205,10 @@ class ProcessEnvPool:
         return slice(w * E, (w + 1) * E)
 
     def _spawn(self, w: int) -> None:
+        self._start(w)
+        self._wait_ready(w)
+
+    def _start(self, w: int) -> None:
         parent_conn, child_conn = _CTX.Pipe()
         E = self._envs_per_worker
         offset = (
@@ -224,6 +233,8 @@ class ProcessEnvPool:
         child_conn.close()
         self._procs[w] = proc
         self._conns[w] = parent_conn
+
+    def _wait_ready(self, w: int) -> None:
         msg = self._recv(w)
         if msg[0] != "ready":
             raise RuntimeError(f"env worker {w} failed to start: {msg!r}")
