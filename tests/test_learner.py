@@ -202,6 +202,43 @@ def test_cartpole_smoke_learns():
     assert result.num_frames == 250 * 4 * 20
 
 
+def test_pixel_policy_learns_from_signal_env():
+    """The FULL conv pipeline learns end-to-end: SignalEnv encodes the
+    rewarded action in the pixels, so rising return proves obs transport,
+    conv torso, V-trace, and the optimizer are wired correctly at pixel
+    shapes (not just CartPole's 4-vector)."""
+    import flax.linen as nn
+
+    from torched_impala_tpu.envs.fake import SignalEnv
+
+    class TinyConv(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(jnp.float32) / 255.0
+            x = nn.relu(nn.Conv(8, (5, 5), strides=(3, 3))(x))
+            x = x.reshape(x.shape[0], -1)
+            return nn.relu(nn.Dense(64)(x))
+
+    agent = Agent(ImpalaNet(num_actions=4, torso=TinyConv()))
+    result = train(
+        agent=agent,
+        env_factory=lambda seed, idx=None: SignalEnv(seed=seed),
+        example_obs=np.zeros((24, 24, 1), np.uint8),
+        num_actors=2,
+        learner_config=LearnerConfig(batch_size=4, unroll_length=10),
+        optimizer=optax.rmsprop(2e-3, decay=0.99, eps=1e-7),
+        total_steps=250,
+        actor_device=None,
+        seed=0,
+    )
+    returns = [r for _, r, _ in result.episode_returns]
+    assert len(returns) >= 100, "too few episodes completed"
+    late = np.mean(returns[-50:])
+    # Random policy averages 5.0 (20 steps x 1/4); reading the pixels
+    # should roughly double that well within 250 learner steps.
+    assert late > 9.0, f"conv pipeline failed to learn: late={late:.1f}"
+
+
 def test_batcher_thread_failure_surfaces():
     """A dead batcher thread must fail the learner loudly, not hang it
     (code-review finding: watchdog only monitored actor threads)."""

@@ -122,6 +122,56 @@ class FakeDiscreteEnv:
         return self._obs(), reward, terminated, False, {}
 
 
+class SignalEnv:
+    """Learnable pixel env: the rewarded action is encoded in the pixels.
+
+    One quadrant of the frame is lit; the matching action (quadrant index)
+    pays reward 1, everything else 0, and a fresh target is drawn every
+    step. Random policy averages episode_len/num_actions per episode, a
+    policy that reads the pixels approaches episode_len — so this gives the
+    full conv pipeline an end-to-end *learning* signal (unlike the
+    random-pixel fakes, which only exercise shapes/throughput).
+    """
+
+    def __init__(
+        self,
+        size: int = 24,
+        num_actions: int = 4,
+        episode_len: int = 20,
+        seed: int = 0,
+    ):
+        assert num_actions <= 4, "targets are encoded as 2x2 quadrants"
+        self._rng = np.random.default_rng(seed)
+        self._size = size
+        self._num_actions = num_actions
+        self._episode_len = episode_len
+        self._t = 0
+        self._target = 0
+
+    @property
+    def action_space_n(self) -> int:
+        return self._num_actions
+
+    def _obs(self) -> np.ndarray:
+        s = self._size
+        h = s // 2
+        obs = np.zeros((s, s, 1), np.uint8)
+        r, c = divmod(self._target, 2)
+        obs[r * h : (r + 1) * h, c * h : (c + 1) * h, :] = 255
+        return obs
+
+    def reset(self, seed=None):
+        self._t = 0
+        self._target = int(self._rng.integers(self._num_actions))
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._target else 0.0
+        self._t += 1
+        self._target = int(self._rng.integers(self._num_actions))
+        return self._obs(), reward, self._t >= self._episode_len, False, {}
+
+
 class CrashingFactory:
     """Picklable env factory that wraps another factory's envs in
     `CrashingEnv` — chaos mode for both thread and process actors."""
