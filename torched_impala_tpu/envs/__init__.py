@@ -10,6 +10,8 @@ from torched_impala_tpu.envs.factory import (  # noqa: F401
 )
 from torched_impala_tpu.envs.fake import (  # noqa: F401
     CrashingEnv,
+    CrashingFactory,
+    SignalEnv,
     FakeAtariEnv,
     FakeDiscreteEnv,
     ScriptedEnv,
@@ -18,6 +20,8 @@ from torched_impala_tpu.envs.fake import (  # noqa: F401
 __all__ = [
     "FACTORIES",
     "CrashingEnv",
+    "CrashingFactory",
+    "SignalEnv",
     "EnvSpec",
     "FakeAtariEnv",
     "FakeDiscreteEnv",

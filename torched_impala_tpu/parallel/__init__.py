@@ -9,6 +9,7 @@ from torched_impala_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     state_sharding,
 )
+from torched_impala_tpu.parallel import multihost  # noqa: F401
 from torched_impala_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,
@@ -17,6 +18,7 @@ from torched_impala_tpu.parallel.ring_attention import (  # noqa: F401
 
 __all__ = [
     "DATA_AXIS",
+    "multihost",
     "MODEL_AXIS",
     "batch_sharding",
     "make_mesh",

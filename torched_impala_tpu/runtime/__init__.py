@@ -1,6 +1,9 @@
 """Actor-learner runtime: actors, batcher, learner, param publication."""
 
 from torched_impala_tpu.runtime.actor import Actor  # noqa: F401
+from torched_impala_tpu.runtime.env_pool import (  # noqa: F401
+    ProcessEnvPool,
+)
 from torched_impala_tpu.runtime.evaluator import (  # noqa: F401
     EvalResult,
     run_episodes,
@@ -29,6 +32,7 @@ __all__ = [
     "Learner",
     "LearnerConfig",
     "ParamStore",
+    "ProcessEnvPool",
     "QueueClosed",
     "TrainResult",
     "Trajectory",
