@@ -156,6 +156,56 @@ class TestPooledVectorActor:
                 p.behaviour_logits, l.behaviour_logits
             )
 
+    def test_pooled_matches_thread_trajectories_lstm(self):
+        """Recurrent carry across unrolls: the pooled path must thread the
+        [E,...] LSTM state and episode-boundary first flags identically."""
+        import jax
+
+        agent = Agent(
+            ImpalaNet(
+                num_actions=2, torso=MLPTorso(), use_lstm=True, lstm_size=8
+            )
+        )
+        params = agent.init_params(
+            jax.random.key(1), np.zeros((4,), np.float32)
+        )
+        store = ParamStore()
+        store.publish(0, params)
+
+        def collect(envs_arg):
+            out = []
+            actor = VectorActor(
+                actor_id=0,
+                envs=envs_arg,
+                agent=agent,
+                param_store=store,
+                enqueue=out.append,
+                unroll_length=4,  # episodes (len 5) straddle unrolls
+                seed=7,
+            )
+            for _ in range(3):
+                actor.unroll_and_push()
+            return out
+
+        pool = make_pool(num_workers=2, envs_per_worker=2)
+        try:
+            pooled = collect(pool)
+        finally:
+            pool.close()
+        local = collect([scripted_factory(0, i) for i in range(4)])
+        assert len(pooled) == len(local) == 12
+        for p, l in zip(pooled, local):
+            np.testing.assert_array_equal(p.obs, l.obs)
+            np.testing.assert_array_equal(p.actions, l.actions)
+            np.testing.assert_array_equal(p.first, l.first)
+            for a, b in zip(
+                jax.tree.leaves(p.agent_state),
+                jax.tree.leaves(l.agent_state),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)
+                )
+
     def test_train_process_mode_e2e(self):
         agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
         result = train(

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Watch for axon tunnel recovery; run bench.py the moment it heals.
+cd /root/repo
+for i in $(seq 1 40); do
+  if timeout 150 python -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) tunnel ALIVE (iter $i); running bench" >> /tmp/tunnel_watch.log
+    timeout 3000 python bench.py > /root/repo/BENCH_watch.json 2> /tmp/bench_watch.log
+    echo "$(date +%H:%M:%S) bench rc=$? json=$(cat /root/repo/BENCH_watch.json | head -c 200)" >> /tmp/tunnel_watch.log
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) tunnel still wedged (iter $i)" >> /tmp/tunnel_watch.log
+  sleep 600
+done
+echo "$(date +%H:%M:%S) gave up after 40 iters" >> /tmp/tunnel_watch.log
