@@ -150,6 +150,7 @@ class ProcessEnvPool:
         obs_dtype,
         base_seed: int = 0,
         seed_stride: int = 1000,
+        first_env_index: int = 0,
         max_restarts: int = 10,
         step_timeout: float = 300.0,
     ) -> None:
@@ -169,6 +170,7 @@ class ProcessEnvPool:
         self._obs_dtype = np.dtype(obs_dtype)
         self._base_seed = base_seed
         self._seed_stride = seed_stride
+        self._first_env_index = first_env_index
         self._max_restarts = max_restarts
         self._step_timeout = step_timeout
         self.restarts = 0
@@ -223,7 +225,7 @@ class ProcessEnvPool:
                 self._factory_bytes,
                 E,
                 self._base_seed + self._seed_stride * (w + 1),
-                w * E,
+                self._first_env_index + w * E,
                 self._obs_shape,
                 self._obs_dtype.str,
             ),

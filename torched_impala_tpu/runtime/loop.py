@@ -171,23 +171,40 @@ def train(
             return env_factory(seed_, env_index)
         return env_factory(seed_)
 
-    env_pool = None
+    env_pools: list = []
     if actor_mode == "process":
         from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
 
-        env_pool = ProcessEnvPool(
-            env_factory=env_factory,
-            num_workers=num_actors,
-            envs_per_worker=envs_per_actor,
-            obs_shape=example_obs.shape,
-            obs_dtype=example_obs.dtype,
-            base_seed=seed,
-            max_restarts=(
-                max_actor_restarts * num_actors
-                if max_actor_restarts is not None
-                else 1_000_000
-            ),
+        # Two pools (when there are >= 2 workers), each driven by its own
+        # batched-inference thread: while one thread waits on its workers'
+        # env steps, the other runs its policy batch — inference and env
+        # stepping overlap instead of serializing. Worker slot w keeps
+        # global env indices regardless of the split.
+        groups = (
+            [list(range(num_actors))]
+            if num_actors < 2
+            else [
+                list(range(0, num_actors // 2)),
+                list(range(num_actors // 2, num_actors)),
+            ]
         )
+        for gi, group in enumerate(groups):
+            env_pools.append(
+                ProcessEnvPool(
+                    env_factory=env_factory,
+                    num_workers=len(group),
+                    envs_per_worker=envs_per_actor,
+                    obs_shape=example_obs.shape,
+                    obs_dtype=example_obs.dtype,
+                    base_seed=seed + 1000 * group[0],
+                    first_env_index=group[0] * envs_per_actor,
+                    max_restarts=(
+                        max_actor_restarts * len(group)
+                        if max_actor_restarts is not None
+                        else 1_000_000
+                    ),
+                )
+            )
 
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the
@@ -203,11 +220,11 @@ def train(
             on_episode_return=on_episode_return,
             device=device,
         )
-        if env_pool is not None:
-            # One batched-inference actor over the whole pool; the pool
-            # itself repairs dead workers, so a supervisor respawn of this
-            # actor just re-attaches to the live pool.
-            return VectorActor(envs=env_pool, **common)
+        if env_pools:
+            # One batched-inference actor per pool; pools repair their own
+            # dead workers, so a supervisor respawn of this actor just
+            # re-attaches to the live pool.
+            return VectorActor(envs=env_pools[slot], **common)
         if envs_per_actor > 1:
             return VectorActor(
                 envs=[
@@ -229,8 +246,8 @@ def train(
 
     supervisor = ActorSupervisor(
         make_actor=make_actor,
-        # Process mode runs ONE batched-inference thread over the pool.
-        num_actors=1 if env_pool is not None else num_actors,
+        # Process mode runs one batched-inference thread per pool.
+        num_actors=len(env_pools) if env_pools else num_actors,
         stop_event=stop_event,
         max_restarts_per_actor=max_actor_restarts,
         on_restart=on_restart,
@@ -267,8 +284,8 @@ def train(
         except Exception:
             pass
         supervisor.join()
-        if env_pool is not None:
-            env_pool.close()
+        for pool in env_pools:
+            pool.close()
 
     if checkpointer is not None:
         checkpointer.save(learner.num_steps, learner.get_state())
@@ -282,5 +299,5 @@ def train(
         learner=learner,
         num_frames=learner.num_frames,
         actor_restarts=supervisor.restarts
-        + (env_pool.restarts if env_pool is not None else 0),
+        + sum(pool.restarts for pool in env_pools),
     )
