@@ -224,3 +224,31 @@ class TestPooledVectorActor:
         assert result.learner.num_steps == 3
         assert result.num_frames == 3 * 2 * 4
         assert np.isfinite(result.final_logs.get("total_loss", np.nan))
+
+    def test_train_process_mode_with_dp_mesh(self):
+        """Process actors + DP-sharded learner together: the full
+        production composition (worker processes -> pooled inference ->
+        batcher -> sharded device_put -> pjit all-reduce)."""
+        from torched_impala_tpu.parallel import make_mesh
+
+        agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+        result = train(
+            agent=agent,
+            env_factory=discrete_factory,
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(batch_size=4, unroll_length=4),
+            optimizer=optax.sgd(1e-3),
+            total_steps=2,
+            envs_per_actor=2,
+            actor_mode="process",
+            actor_device=None,
+            log_every=1,
+            mesh=make_mesh(num_data=4),
+        )
+        assert result.learner.num_steps == 2
+        assert np.isfinite(result.final_logs.get("total_loss", np.nan))
+        import jax
+
+        for leaf in jax.tree.leaves(result.learner.params):
+            assert leaf.sharding.is_fully_replicated

@@ -288,6 +288,7 @@ PONG = ExperimentConfig(
     compute_dtype="bfloat16",
     episodic_life=True,
     fire_reset=True,
+    actor_mode="process",
     num_actors=32,
     unroll_length=20,
     batch_size=32,
@@ -306,6 +307,7 @@ BREAKOUT = ExperimentConfig(
     episodic_life=True,
     fire_reset=True,
     use_lstm=True,
+    actor_mode="process",
     num_actors=256,
     unroll_length=20,
     batch_size=32,
@@ -321,6 +323,7 @@ PROCGEN = ExperimentConfig(
     num_actions=15,
     model="deep_resnet",
     compute_dtype="bfloat16",
+    actor_mode="process",
     num_actors=512,
     unroll_length=20,
     batch_size=64,
@@ -339,6 +342,7 @@ DMLAB30 = ExperimentConfig(
     model="deep_resnet",
     compute_dtype="bfloat16",
     use_lstm=True,
+    actor_mode="process",
     num_actors=256,
     unroll_length=100,
     batch_size=32,
@@ -365,6 +369,7 @@ PONG_TRANSFORMER = ExperimentConfig(
     transformer_layers=2,
     transformer_heads=4,
     transformer_window=128,
+    actor_mode="process",
     num_actors=32,
     unroll_length=20,
     batch_size=32,
