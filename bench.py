@@ -416,6 +416,16 @@ def run_e2e(jax, tpu_ok: bool, actor_mode: str) -> dict:
 
 if __name__ == "__main__":
     try:
+        # Hard wall-clock bound: if the tunnel wedges MID-run (probe passed
+        # but a later dispatch hangs), fail into the JSON error path instead
+        # of hanging the driver.
+        import signal
+
+        def _alarm(signum, frame):
+            raise TimeoutError("bench wall-clock limit hit (wedged tunnel?)")
+
+        signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(2400)
         main()
     except Exception as e:  # still emit ONE parseable JSON line
         import traceback
