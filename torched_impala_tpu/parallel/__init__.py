@@ -15,6 +15,10 @@ from torched_impala_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention_sharded,
     seq_mesh,
 )
+from torched_impala_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -26,5 +30,7 @@ __all__ = [
     "ring_attention",
     "ring_attention_sharded",
     "seq_mesh",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "state_sharding",
 ]

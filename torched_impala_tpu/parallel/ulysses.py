@@ -1,0 +1,115 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second canonical long-context strategy next to ring attention
+(SURVEY.md §6 long-context row names "ring attention / blockwise /
+Ulysses"): instead of rotating KV blocks around a ring while the sequence
+stays sharded, Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) re-shards
+ACROSS the attention op —
+
+- outside attention, activations are sequence-sharded `[T/n, B, H, Dh]`
+  (every token-parallel op — projections, MLPs — is embarrassingly
+  parallel over T);
+- for attention, one `all_to_all` swaps the sharded axis: each device
+  trades its T/n slice of all H heads for the FULL sequence of H/n heads
+  (`[T, B, H/n, Dh]`), computes exact dense attention for its head group
+  (heads are independent), and a second `all_to_all` swaps back.
+
+Tradeoffs vs the ring (both exact): Ulysses moves activations twice per
+attention through one fused all-to-all each way (bandwidth ~2·T·B·H·Dh/n
+per device, latency O(1) collectives) and needs H divisible by n; the ring
+keeps memory strictly blockwise (only one KV block resident) and overlaps
+its n ppermute hops with compute, but runs n sequential rounds. On ICI
+both map well; which wins is shape-dependent — having both behind the same
+`[T, B, H, Dh]` interface lets callers measure.
+
+XLA note: `jax.lax.all_to_all(..., tiled=True)` lowers to a single
+AllToAll HLO over the named axis — the same collective the TPU runtime
+rides for expert parallelism, so it is ICI-efficient by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on `axis_name`.
+
+    Args:
+      q, k, v: `[T_local, B, H, Dh]` — the local shard of a `[T_global]`
+        sequence. H must be divisible by the axis size.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: standard causal masking over global positions.
+
+    Returns:
+      `[T_local, B, H, Dh]` attention output, sequence-sharded like q.
+    """
+    n = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"num heads {h} not divisible by axis size {n}")
+
+    # [T/n, B, H, Dh] -> all-to-all -> [T, B, H/n, Dh]: concat_axis=0
+    # gathers the sequence, split_axis=2 scatters the heads.
+    def to_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=0, tiled=True
+        )
+
+    def to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=0, concat_axis=2, tiled=True
+        )
+
+    qh = to_heads(q.astype(jnp.float32))  # [T, B, H/n, Dh]
+    kh = to_heads(k.astype(jnp.float32))
+    vh = to_heads(v.astype(jnp.float32))
+
+    t = qh.shape[0]
+    dh = qh.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    logits = jnp.einsum("tbhd,sbhd->tbhs", qh, kh) * scale
+    if causal:
+        visible = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        logits = jnp.where(visible[:, None, None, :], logits, NEG_INF)
+    out = jnp.einsum(
+        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), vh
+    )
+    return to_seq(out).astype(q.dtype)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    """Global-view wrapper mirroring `ring_attention_sharded`: q/k/v
+    `[T_global, B, H, Dh]`; shards T over `axis_name`, re-shards across
+    the attention with all-to-alls, returns the global result. T_global
+    and H must divide evenly by the axis size."""
+    spec = P(axis_name)
+    fn = functools.partial(
+        ulysses_attention, axis_name=axis_name, causal=causal
+    )
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
+    return sharded(put(q), put(k), put(v))
