@@ -1,0 +1,22 @@
+"""Shared dense-attention oracle for the SP attention tests.
+
+Single source of truth for what "exact attention" means: both the ring
+(tests/test_ring_attention.py) and Ulysses (tests/test_ulysses.py) sharded
+implementations are validated against this same reference, so a change to
+the oracle (mask constant, scale, dtype) cannot drift between them.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention(q, k, v, causal):
+    T = q.shape[0]
+    dh = q.shape[-1]
+    logits = jnp.einsum("tbhd,sbhd->tbhs", q, k) / jnp.sqrt(float(dh))
+    if causal:
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    return jnp.einsum(
+        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), v
+    )

@@ -16,17 +16,7 @@ from torched_impala_tpu.parallel.ring_attention import (
 )
 from torched_impala_tpu.parallel.ulysses import ulysses_attention_sharded
 
-
-def dense_attention(q, k, v, causal):
-    T = q.shape[0]
-    dh = q.shape[-1]
-    logits = jnp.einsum("tbhd,sbhd->tbhs", q, k) / jnp.sqrt(float(dh))
-    if causal:
-        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
-        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
-    return jnp.einsum(
-        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), v
-    )
+from attention_oracle import dense_attention
 
 
 def _qkv(rng, T, B=2, H=4, Dh=8):

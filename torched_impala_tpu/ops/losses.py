@@ -38,7 +38,9 @@ class ImpalaLossConfig:
     # 'sum' matches the reference (losses summed over [T, B]); 'mean' divides
     # by the number of valid steps, decoupling lr from unroll/batch size.
     reduction: str = "sum"
-    vtrace_implementation: str = "scan"
+    # 'auto' = fused Pallas kernel on TPU (measured 1.3-2.8x faster than the
+    # scan on a v5e, bench.py `vtrace_pallas_vs_scan`), lax.scan elsewhere.
+    vtrace_implementation: str = "auto"
 
 
 class LossOutput(NamedTuple):

@@ -46,6 +46,20 @@ class VTraceOutput(NamedTuple):
     errors: jax.Array
 
 
+def _default_backend_is_tpu() -> bool:
+    """True iff the default backend's devices are TPUs.
+
+    Keyed off `Device.platform` rather than the backend *name*: TPU plugins
+    register under drifting names (this machine's tunnelled v5e registers as
+    'axon' yet its devices report platform 'tpu'), and a name check would
+    silently route 'auto' to the scan on real hardware.
+    """
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def importance_ratios(
     target_log_probs: jax.Array, behaviour_log_probs: jax.Array
 ) -> jax.Array:
@@ -133,13 +147,20 @@ def vtrace(
     clip_c_threshold: float = 1.0,
     clip_pg_rho_threshold: float = 1.0,
     lambda_: float = 1.0,
-    implementation: str = "scan",
+    implementation: str = "auto",
 ) -> VTraceOutput:
-    """V-trace with a selectable backend: 'scan' (XLA) or 'pallas' (TPU kernel).
+    """V-trace with a selectable backend: 'auto', 'scan' (XLA), or 'pallas'
+    (TPU kernel).
 
     Both backends compute identical math; 'pallas' fuses the whole recursion
     (ratio clipping, delta computation, reverse scan, pg advantage) into one
     VMEM-resident kernel. See `vtrace_pallas.py`.
+
+    'auto' resolves at trace time: the Pallas kernel on the TPU backend, the
+    scan elsewhere (CPU meshes run the scan; the kernel would fall back to the
+    interpreter there anyway). Measured on a real v5e chip (bench.py
+    `vtrace_pallas_vs_scan`, 2026-07-29): pallas 2.81x faster at Pong shapes
+    (T=20, B=256) and 1.27x at DMLab shapes (T=100, B=32).
     """
     kwargs = dict(
         log_rhos=log_rhos,
@@ -152,6 +173,8 @@ def vtrace(
         clip_pg_rho_threshold=clip_pg_rho_threshold,
         lambda_=lambda_,
     )
+    if implementation == "auto":
+        implementation = "pallas" if _default_backend_is_tpu() else "scan"
     if implementation == "scan":
         return vtrace_scan(**kwargs)
     if implementation == "pallas":

@@ -106,7 +106,9 @@ def vtrace_pallas(
     meshes run the same code path.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from torched_impala_tpu.ops.vtrace import _default_backend_is_tpu
+
+        interpret = not _default_backend_is_tpu()
     T, B = rewards.shape
     f32 = jnp.float32
 

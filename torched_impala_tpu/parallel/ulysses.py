@@ -74,19 +74,29 @@ def ulysses_attention(
             x, axis_name, split_axis=0, concat_axis=2, tiled=True
         )
 
-    qh = to_heads(q.astype(jnp.float32))  # [T, B, H/n, Dh]
-    kh = to_heads(k.astype(jnp.float32))
-    vh = to_heads(v.astype(jnp.float32))
+    # Reshard in the INPUT dtype (half the ICI bytes for bf16 activations),
+    # upcast only for the math: f32 logits/softmax, identical results.
+    qh = to_heads(q)  # [T, B, H/n, Dh]
+    kh = to_heads(k)
+    vh = to_heads(v)
 
     t = qh.shape[0]
     dh = qh.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
-    logits = jnp.einsum("tbhd,sbhd->tbhs", qh, kh) * scale
+    logits = (
+        jnp.einsum(
+            "tbhd,sbhd->tbhs", qh, kh, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     if causal:
         visible = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
         logits = jnp.where(visible[:, None, None, :], logits, NEG_INF)
     out = jnp.einsum(
-        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), vh
+        "tbhs,sbhd->tbhd",
+        jax.nn.softmax(logits, axis=-1),
+        vh,
+        preferred_element_type=jnp.float32,
     )
     return to_seq(out).astype(q.dtype)
 
