@@ -121,6 +121,13 @@ def main() -> None:
     # low-core box) hitting the wall-clock alarm can't starve them.
     if tpu_ok:
         try:
+            result["learner_deep_breakout"] = run_bench_deep(jax)
+        except Exception as e:
+            log(f"bench: deep learner bench failed: {type(e).__name__}: {e}")
+            result["learner_deep_breakout"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]
+            }
+        try:
             result["vtrace_pallas_vs_scan"] = run_vtrace_kernel_compare(jax)
         except Exception as e:
             log(f"bench: kernel compare failed: {type(e).__name__}: {e}")
@@ -191,17 +198,20 @@ def run_bench(jax, tpu_ok: bool) -> None:
     arrays = jax.device_put(arrays)
 
     params, opt_state, pa = learner.params, learner.opt_state, ()
-    # Warmup/compile.
-    params, opt_state, pa, logs = learner._train_step(
+    # AOT: lower+compile ONCE and reuse the executable for warmup, timing,
+    # trace capture, and cost_analysis (a second .lower().compile() would
+    # not share the jit cache and recompiles the whole program).
+    step_fn = learner._train_step.lower(
         params, opt_state, pa, *arrays
-    )
+    ).compile()
+    params, opt_state, pa, logs = step_fn(params, opt_state, pa, *arrays)
     jax.block_until_ready(logs)
     log(f"bench: compiled, total_loss={float(logs['total_loss']):.3f}")
 
     steps = 30 if tpu_ok else 5
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_state, pa, logs = learner._train_step(
+        params, opt_state, pa, logs = step_fn(
             params, opt_state, pa, *arrays
         )
     jax.block_until_ready(logs)
@@ -216,7 +226,7 @@ def run_bench(jax, tpu_ok: bool) -> None:
             trace_dir = os.path.join(REPO, "traces", "bench")
             with jax.profiler.trace(trace_dir, create_perfetto_link=False):
                 for _ in range(5):
-                    params, opt_state, pa, logs = learner._train_step(
+                    params, opt_state, pa, logs = step_fn(
                         params, opt_state, pa, *arrays
                     )
                 jax.block_until_ready(logs)
@@ -245,11 +255,7 @@ def run_bench(jax, tpu_ok: bool) -> None:
         # XLA's own FLOP count for the compiled train step -> rough MFU
         # against the v5e bf16 peak (197 TFLOP/s/chip). "Rough": XLA counts
         # algebraic flops, not MXU-padded ones.
-        cost = (
-            learner._train_step.lower(params, opt_state, pa, *arrays)
-            .compile()
-            .cost_analysis()
-        )
+        cost = step_fn.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
@@ -271,6 +277,89 @@ def run_bench(jax, tpu_ok: bool) -> None:
         f"on {n_chips} {jax.default_backend()} device(s)"
     )
     return result
+
+
+def run_bench_deep(jax) -> dict:
+    """Flagship-model learner throughput: IMPALA deep ResNet + LSTM(256) at
+    the breakout preset's shapes (T=20, B=32, bf16 torso — BASELINE config 3).
+    Secondary to the headline Pong number; measures the model family the
+    Breakout/DMLab presets actually train. TPU-only (skipped on the CPU
+    fallback — the deep stack takes minutes to compile there)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torched_impala_tpu.models import Agent, AtariDeepTorso, ImpalaNet
+    from torched_impala_tpu.ops import ImpalaLossConfig
+    from torched_impala_tpu.runtime import Learner, LearnerConfig
+
+    T, B, num_actions = 20, 32, 4
+    agent = Agent(
+        ImpalaNet(
+            num_actions=num_actions,
+            torso=AtariDeepTorso(dtype=jnp.bfloat16),
+            use_lstm=True,
+        )
+    )
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.rmsprop(4e-4, decay=0.99, eps=1e-7),
+        config=LearnerConfig(
+            batch_size=B,
+            unroll_length=T,
+            loss=ImpalaLossConfig(reduction="sum"),
+            publish_interval=1_000_000,
+        ),
+        example_obs=np.zeros((84, 84, 4), np.uint8),
+        rng=jax.random.key(0),
+    )
+    rng = np.random.default_rng(0)
+    arrays = (
+        jnp.asarray(
+            rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
+        ),
+        jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
+        jnp.asarray(rng.integers(0, num_actions, size=(T, B), dtype=np.int32)),
+        jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
+        jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+        jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
+        jnp.zeros((B,), jnp.int32),
+        agent.initial_state(B),
+    )
+    arrays = jax.device_put(arrays)
+    params, opt_state, pa = learner.params, learner.opt_state, ()
+    step_fn = learner._train_step.lower(
+        params, opt_state, pa, *arrays
+    ).compile()  # AOT: one compile shared with timing + cost_analysis
+    params, opt_state, pa, logs = step_fn(params, opt_state, pa, *arrays)
+    jax.block_until_ready(logs)
+    steps = 30
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, pa, logs = step_fn(
+            params, opt_state, pa, *arrays
+        )
+    jax.block_until_ready(logs)
+    dt = time.perf_counter() - t0
+    fps = T * B * steps / dt
+    out = {
+        "frames_per_sec_per_chip": round(fps, 1),
+        "model": "deep_resnet+lstm256",
+        "T": T,
+        "B": B,
+    }
+    try:
+        cost = step_fn.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        if flops > 0:
+            out["train_step_gflops"] = round(flops / 1e9, 2)
+            out["mfu_estimate"] = round((flops * steps / dt) / 197e12, 4)
+    except Exception as e:
+        log(f"bench: deep cost_analysis unavailable: {type(e).__name__}: {e}")
+    log(f"bench: deep learner {steps} steps in {dt:.3f}s -> {fps:,.0f} f/s")
+    return out
 
 
 def run_vtrace_kernel_compare(jax) -> dict:

@@ -278,3 +278,27 @@ def test_batcher_thread_failure_surfaces():
                     raise
         raise AssertionError("batcher failure never surfaced")
     learner.stop()
+
+
+def test_vtrace_auto_resolves_to_devices_not_default_backend():
+    """'auto' must resolve against the learner's actual compute devices at
+    construction (a CPU mesh in a TPU-default process would otherwise lower
+    the compiled Pallas kernel for CPU and fail)."""
+    from torched_impala_tpu.parallel import make_mesh
+
+    agent = _agent()
+    for mesh in (None, make_mesh(num_data=2, devices=jax.devices("cpu")[:2])):
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-3),
+            config=LearnerConfig(
+                batch_size=2,
+                unroll_length=3,
+                loss=ImpalaLossConfig(),  # vtrace_implementation='auto'
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+            mesh=mesh,
+        )
+        # Test env forces the CPU platform, so 'auto' must become 'scan'.
+        assert learner._config.loss.vtrace_implementation == "scan"

@@ -156,11 +156,12 @@ def vtrace(
     (ratio clipping, delta computation, reverse scan, pg advantage) into one
     VMEM-resident kernel. See `vtrace_pallas.py`.
 
-    'auto' resolves at trace time: the Pallas kernel on the TPU backend, the
-    scan elsewhere (CPU meshes run the scan; the kernel would fall back to the
-    interpreter there anyway). Measured on a real v5e chip (bench.py
-    `vtrace_pallas_vs_scan`, 2026-07-29): pallas 2.81x faster at Pong shapes
-    (T=20, B=256) and 1.27x at DMLab shapes (T=100, B=32).
+    'auto' here is a trace-time fallback keyed off the DEFAULT backend's
+    device platform. Callers that know their actual compute devices should
+    resolve 'auto' themselves (runtime.Learner does, so a CPU mesh built in
+    a TPU-default process still gets the scan). Measured on a real v5e chip
+    (bench.py `vtrace_pallas_vs_scan`, 2026-07-29): pallas 2.81x faster at
+    Pong shapes (T=20, B=256) and 1.27x at DMLab shapes (T=100, B=32).
     """
     kwargs = dict(
         log_rhos=log_rhos,

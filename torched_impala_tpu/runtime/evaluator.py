@@ -75,11 +75,13 @@ def run_episodes(
         first = True
         ep_return, ep_len = 0.0, 0
         while True:
+            # Host numpy in, so placement follows params (no stray transfer
+            # onto the default device — see vector_actor.py on the cost).
             key, action, state = step_fn(
                 params,
                 key,
-                jnp.asarray(np.asarray(obs))[None],
-                jnp.asarray([first]),
+                np.asarray(obs)[None],
+                np.asarray([first]),
                 state,
             )
             obs, reward, terminated, truncated, _ = env.step(int(action[0]))

@@ -121,6 +121,25 @@ class Learner:
         self._config = config
         self._logger = logger
         self._mesh = mesh
+        if config.loss.vtrace_implementation == "auto":
+            # Resolve 'auto' HERE, where the compute devices are known: the
+            # trace-time fallback inside ops.vtrace keys off the default
+            # backend, which is wrong for e.g. a CPU mesh built in a process
+            # whose default backend is a TPU (the compiled Pallas kernel
+            # would be lowered for CPU and fail).
+            devs = mesh.devices.flat if mesh is not None else jax.devices()
+            impl = (
+                "pallas"
+                if next(iter(devs)).platform == "tpu"
+                else "scan"
+            )
+            config = dataclasses.replace(
+                config,
+                loss=dataclasses.replace(
+                    config.loss, vtrace_implementation=impl
+                ),
+            )
+            self._config = config
         if mesh is not None and config.batch_size % mesh.shape[DATA_AXIS]:
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by data axis "
@@ -378,6 +397,12 @@ class Learner:
     # ---- stepping ------------------------------------------------------
 
     def _publish(self) -> None:
+        # Kick off all leaf D2H copies before materializing any: np.asarray
+        # alone would serialize one synchronous transfer per leaf (each a
+        # full round trip on a tunnelled device).
+        for leaf in jax.tree.leaves(self._params):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
         host_params = jax.tree.map(np.asarray, self._params)
         self.param_store.publish(self.num_frames, host_params)
 

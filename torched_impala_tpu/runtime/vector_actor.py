@@ -23,7 +23,6 @@ import threading
 from typing import Callable, List, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from torched_impala_tpu.models.agent import Agent
@@ -119,6 +118,10 @@ class VectorActor:
             raise ValueError("tasks must have one entry per env")
         self._first = np.ones((E,), np.bool_)
         self._state = agent.initial_state(E)
+        if device is not None:
+            # Keep the recurrent carry on the inference device from step 0;
+            # initial_state materializes on the default backend otherwise.
+            self._state = jax.device_put(self._state, device)
         self._episode_return = np.zeros((E,), np.float64)
         self._episode_len = np.zeros((E,), np.int64)
 
@@ -144,11 +147,17 @@ class VectorActor:
         for t in range(T):
             obs_buf[t] = self._obs
             first_buf[t] = self._first
+            # Pass obs/first as host numpy: jit placement then follows the
+            # committed params/key (the pinned inference device). A bare
+            # `jnp.asarray` here would materialize them on the DEFAULT
+            # device first — with a tunnelled TPU that is two synchronous
+            # tunnel crossings per env step (measured ~100-300ms/frame,
+            # ~25x actor slowdown) before execution even starts.
             self._key, out = self._step_fn(
                 params,
                 self._key,
-                jnp.asarray(self._obs),
-                jnp.asarray(self._first),
+                self._obs,
+                self._first,
                 self._state,
             )
             self._state = out.state
