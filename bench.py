@@ -127,6 +127,7 @@ def main() -> None:
             result["learner_deep_breakout"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]
             }
+    if tpu_ok:
         try:
             result["vtrace_pallas_vs_scan"] = run_vtrace_kernel_compare(jax)
         except Exception as e:
@@ -134,6 +135,11 @@ def main() -> None:
             result["vtrace_pallas_vs_scan"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]
             }
+    try:
+        result["anakin_cartpole"] = run_bench_anakin(jax, tpu_ok)
+    except Exception as e:
+        log(f"bench: anakin bench failed: {type(e).__name__}: {e}")
+        result["anakin_cartpole"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     for mode in ("thread", "process"):
         try:
             result[f"e2e_{mode}"] = run_e2e(jax, tpu_ok, mode)
@@ -360,6 +366,48 @@ def run_bench_deep(jax) -> dict:
         log(f"bench: deep cost_analysis unavailable: {type(e).__name__}: {e}")
     log(f"bench: deep learner {steps} steps in {dt:.3f}s -> {fps:,.0f} f/s")
     return out
+
+
+def run_bench_anakin(jax, tpu_ok: bool) -> dict:
+    """Fully on-device actor-learner throughput (runtime/anakin.py): pure-JAX
+    CartPole envs + MLP policy + V-trace update fused into one XLA program.
+    This is the TPU-native architecture the 1M env-frames/s north star
+    (BASELINE.json:5) actually favours — no host actors, no H2D, the env IS
+    part of the compiled step. env-frames/s = E * T * iters / wall."""
+    import optax
+
+    from torched_impala_tpu.envs import JaxCartPole
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.ops import ImpalaLossConfig
+    from torched_impala_tpu.runtime import AnakinConfig, AnakinRunner
+
+    E, T, iters = (2048, 32, 30) if tpu_ok else (64, 16, 5)
+    runner = AnakinRunner(
+        agent=Agent(
+            ImpalaNet(num_actions=2, torso=MLPTorso(hidden_sizes=(64, 64)))
+        ),
+        env=JaxCartPole(),
+        optimizer=optax.rmsprop(3e-4, decay=0.99, eps=1e-7),
+        config=AnakinConfig(
+            num_envs=E,
+            unroll_length=T,
+            loss=ImpalaLossConfig(reduction="mean"),
+        ),
+        rng=jax.random.key(0),
+    )
+    runner.step()  # compile
+    out = runner.run(iters)
+    result = {
+        "env_frames_per_sec": round(out["frames_per_sec"], 1),
+        "E": E,
+        "T": T,
+        "vs_north_star_1M": round(out["frames_per_sec"] / 1_000_000.0, 3),
+    }
+    log(
+        f"bench: anakin E={E} T={T}: "
+        f"{out['frames_per_sec']:,.0f} env-frames/s on-device"
+    )
+    return result
 
 
 def run_vtrace_kernel_compare(jax) -> dict:

@@ -1,0 +1,93 @@
+"""Pure-JAX envs: step-for-step parity with gymnasium CartPole-v1 and
+Catch invariants. These envs back the on-device Anakin path
+(runtime/anakin.py), so their dynamics must match the host envs exactly —
+a config switched between host actors and Anakin should see the same MDP.
+"""
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torched_impala_tpu.envs import JaxCartPole, JaxCatch
+
+
+class TestJaxCartPole:
+    def test_matches_gymnasium_step_for_step(self):
+        env = JaxCartPole()
+        gym_env = gymnasium.make("CartPole-v1").unwrapped
+        gym_env.reset(seed=0)
+        key = jax.random.key(0)
+        state = env.reset(key)
+        # Start both from the jax reset state.
+        gym_env.state = np.asarray(env.observe(state), np.float64)
+        step = jax.jit(env.step)
+        rng = np.random.default_rng(1)
+        for t in range(200):
+            action = int(rng.integers(0, 2))
+            state, reward, done = step(state, jnp.asarray(action), key)
+            g_obs, g_reward, g_term, g_trunc, _ = gym_env.step(action)
+            np.testing.assert_allclose(
+                np.asarray(env.observe(state)), g_obs, rtol=1e-5, atol=1e-6
+            )
+            assert float(reward) == float(g_reward) == 1.0
+            assert bool(done) == bool(g_term or g_trunc)
+            if done:
+                break
+        assert t > 5, "episode ended implausibly early"
+
+    def test_truncates_at_500_steps(self):
+        env = JaxCartPole()
+        from torched_impala_tpu.envs.jax_envs import CartPoleState
+
+        # Stable physics, one step before the time limit.
+        state = CartPoleState(
+            physics=jnp.zeros((4,), jnp.float32),
+            t=jnp.asarray(499, jnp.int32),
+        )
+        _, _, done = env.step(state, jnp.asarray(0), jax.random.key(0))
+        assert bool(done)
+
+    def test_vmap_shapes(self):
+        env = JaxCartPole()
+        keys = jax.random.split(jax.random.key(0), 7)
+        state = jax.vmap(env.reset)(keys)
+        assert jax.vmap(env.observe)(state).shape == (7, 4)
+        actions = jnp.zeros((7,), jnp.int32)
+        state, reward, done = jax.vmap(env.step)(state, actions, keys)
+        assert jax.vmap(env.observe)(state).shape == (7, 4)
+        assert reward.shape == (7,)
+        assert done.shape == (7,)
+
+
+class TestJaxCatch:
+    def test_episode_length_and_catching(self):
+        env = JaxCatch()
+        key = jax.random.key(3)
+        state = env.reset(key)
+        assert env.observe(state).shape == (env.rows * env.cols,)
+        # Perfect policy: walk the paddle toward the ball column.
+        for t in range(env.rows - 1):
+            dx = int(np.sign(int(state.ball_x) - int(state.paddle_x)))
+            state, reward, done = env.step(state, jnp.asarray(dx + 1), key)
+            if t < env.rows - 2:
+                assert float(reward) == 0.0 and not bool(done)
+        assert bool(done)
+        assert float(reward) == 1.0  # paddle reachable from center
+
+    def test_missing_gives_negative_reward(self):
+        env = JaxCatch()
+        key = jax.random.key(0)
+        # Always move left: with the ball anywhere but the far-left path,
+        # the paddle ends away from the ball.
+        for seed in range(10):
+            state = env.reset(jax.random.key(seed))
+            if int(state.ball_x) == env.cols - 1:
+                break
+        else:
+            pytest.skip("no right-column ball in 10 seeds")
+        done = False
+        while not done:
+            state, reward, done = env.step(state, jnp.asarray(0), key)
+        assert float(reward) == -1.0

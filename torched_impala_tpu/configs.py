@@ -69,6 +69,15 @@ class ExperimentConfig:
     # FLOPs on the MXU's fast path; params, LSTM core, heads, and all loss
     # math stay float32.
     compute_dtype: str = "float32"
+    # Runtime: "actors" = host actor fleet feeding the device learner (the
+    # reference's architecture); "anakin" = fully on-device actor-learner
+    # for pure-JAX env families (runtime/anakin.py; env stepping fused into
+    # the train program, batch_size = number of on-device envs).
+    runtime: str = "actors"
+    # Loss reduction over [T, B]: "sum" matches the reference; "mean"
+    # decouples lr from unroll/batch size (the sane default at anakin env
+    # counts, where T*B is in the thousands).
+    loss_reduction: str = "sum"
     # Scale. `num_actors` is actor threads (actor_mode="thread") or env
     # worker *processes* (actor_mode="process"); each steps
     # `envs_per_actor` envs. Thread mode batches policy dispatch per actor
@@ -160,6 +169,7 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
             discount=cfg.discount,
             vf_coef=cfg.vf_coef,
             entropy_coef=cfg.entropy_coef,
+            reduction=cfg.loss_reduction,
         ),
         max_grad_norm=cfg.max_grad_norm,
         popart=(
@@ -201,6 +211,15 @@ class _EnvFactory:
     def __call__(self, seed: int, env_index=None):
         cfg = self.cfg
         task = self._task_of(seed, env_index)
+        if cfg.env_family.startswith("jax_"):
+            # Pure-JAX envs are their own host fallback: the gym adapter
+            # steps the identical dynamics on CPU, so eval and thread/
+            # process actors see the same MDP as the on-device path.
+            from torched_impala_tpu.envs.jax_envs import JaxEnvGymWrapper
+
+            env = JaxEnvGymWrapper(make_jax_env(cfg), seed=seed)
+            env.task_id = task
+            return env
         if self.fake:
             return self._fake(seed, task)
         from torched_impala_tpu.envs import FACTORIES
@@ -249,6 +268,20 @@ class _EnvFactory:
             task_id=task,
             seed=seed,
         )
+
+
+def make_jax_env(cfg: ExperimentConfig):
+    """Build the pure-JAX env for `runtime="anakin"` presets."""
+    from torched_impala_tpu.envs import JaxCartPole, JaxCatch
+
+    if cfg.env_family == "jax_cartpole":
+        return JaxCartPole()
+    if cfg.env_family == "jax_catch":
+        return JaxCatch()
+    raise ValueError(
+        f"env_family {cfg.env_family!r} has no pure-JAX implementation "
+        "(anakin runtime needs one of: jax_cartpole, jax_catch)"
+    )
 
 
 def make_env_factory(
@@ -376,6 +409,41 @@ PONG_TRANSFORMER = ExperimentConfig(
     total_env_frames=200_000_000,
 )
 
+# On-device (Anakin) presets: the whole actor-learner is one XLA program
+# over pure-JAX envs (runtime/anakin.py). batch_size = on-device env count.
+# Same MDPs as their host counterparts (envs/jax_envs.py parity tests), so
+# eval-mode and host-actor runs of these presets use the identical dynamics
+# through the gym adapter.
+CARTPOLE_ANAKIN = ExperimentConfig(
+    name="cartpole_anakin",
+    env_family="jax_cartpole",
+    obs_shape=(4,),
+    num_actions=2,
+    model="mlp",
+    runtime="anakin",
+    loss_reduction="mean",
+    unroll_length=32,
+    batch_size=256,
+    total_env_frames=4_000_000,
+    lr=3e-3,
+    lr_anneal=False,
+)
+
+CATCH_ANAKIN = ExperimentConfig(
+    name="catch_anakin",
+    env_family="jax_catch",
+    obs_shape=(50,),
+    num_actions=3,
+    model="mlp",
+    runtime="anakin",
+    loss_reduction="mean",
+    unroll_length=16,
+    batch_size=128,
+    total_env_frames=1_000_000,
+    lr=5e-3,
+    lr_anneal=False,
+)
+
 REGISTRY: dict[str, ExperimentConfig] = {
     c.name: c
     for c in (
@@ -385,5 +453,7 @@ REGISTRY: dict[str, ExperimentConfig] = {
         PROCGEN,
         DMLAB30,
         PONG_TRANSFORMER,
+        CARTPOLE_ANAKIN,
+        CATCH_ANAKIN,
     )
 }

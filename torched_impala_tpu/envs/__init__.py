@@ -8,6 +8,10 @@ from torched_impala_tpu.envs.factory import (  # noqa: F401
     make_dmlab,
     make_procgen,
 )
+from torched_impala_tpu.envs.jax_envs import (  # noqa: F401
+    JaxCartPole,
+    JaxCatch,
+)
 from torched_impala_tpu.envs.fake import (  # noqa: F401
     CrashingEnv,
     CrashingFactory,
@@ -25,6 +29,8 @@ __all__ = [
     "EnvSpec",
     "FakeAtariEnv",
     "FakeDiscreteEnv",
+    "JaxCartPole",
+    "JaxCatch",
     "ScriptedEnv",
     "make_atari",
     "make_cartpole",

@@ -46,18 +46,33 @@ class VTraceOutput(NamedTuple):
     errors: jax.Array
 
 
-def _default_backend_is_tpu() -> bool:
-    """True iff the default backend's devices are TPUs.
+def resolve_implementation(implementation: str, devices=None) -> str:
+    """Resolve 'auto' to 'pallas'/'scan' for the given compute devices.
 
     Keyed off `Device.platform` rather than the backend *name*: TPU plugins
     register under drifting names (this machine's tunnelled v5e registers as
     'axon' yet its devices report platform 'tpu'), and a name check would
-    silently route 'auto' to the scan on real hardware.
+    silently route 'auto' to the scan on real hardware. `devices=None`
+    falls back to the default backend's devices — callers that know their
+    actual compute devices (Learner/AnakinRunner pass mesh devices) should
+    pass them.
     """
+    if implementation != "auto":
+        return implementation
     try:
-        return jax.devices()[0].platform == "tpu"
+        if devices is None:
+            devices = jax.devices()
+        return (
+            "pallas" if next(iter(devices)).platform == "tpu" else "scan"
+        )
     except Exception:
-        return False
+        return "scan"
+
+
+def _default_backend_is_tpu() -> bool:
+    """True iff the default backend's devices are TPUs (see
+    `resolve_implementation` on why this checks Device.platform)."""
+    return resolve_implementation("auto") == "pallas"
 
 
 def importance_ratios(

@@ -165,6 +165,9 @@ def main(argv=None) -> int:
             if checkpointer is not None:
                 checkpointer.close()
 
+    if cfg.runtime == "anakin":
+        return run_anakin(args, cfg, agent, mesh, checkpointer)
+
     learner_config = configs.make_learner_config(cfg)
     if args.native_batcher:
         learner_config = dataclasses.replace(
@@ -231,6 +234,100 @@ def main(argv=None) -> int:
         f"frames={result.num_frames} episodes={len(result.episode_returns)} "
         f"recent_return_mean={mean_ret:.2f} "
         f"actor_restarts={result.actor_restarts}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
+    """Train with the fully on-device runtime (runtime/anakin.py).
+
+    total-steps counts ITERATIONS here (each = unroll_length steps of
+    batch_size on-device envs = cfg.frames_per_step frames, same frame
+    accounting as a learner step on the actor runtime). Honors --resume,
+    --checkpoint-interval (plus a final save, crash-safe via finally), and
+    --profile-dir like the actor runtime; env states are not checkpointed
+    (envs restart fresh on resume, exactly as host envs do)."""
+    import time as _time
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.runtime import AnakinConfig, AnakinRunner
+
+    total_steps = (
+        args.total_steps
+        if args.total_steps is not None
+        else cfg.total_learner_steps
+    )
+    logger = make_logger(args)
+    print(
+        f"config={cfg.name} runtime=anakin E={cfg.batch_size} "
+        f"T={cfg.unroll_length} iters={total_steps} "
+        f"mesh={None if mesh is None else dict(mesh.shape)} "
+        f"backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+    runner = AnakinRunner(
+        agent=agent,
+        env=configs.make_jax_env(cfg),
+        optimizer=configs.make_optimizer(cfg),
+        config=AnakinConfig(
+            num_envs=cfg.batch_size,
+            unroll_length=cfg.unroll_length,
+            loss=configs.make_learner_config(cfg).loss,
+        ),
+        rng=jax.random.key(args.seed),
+        mesh=mesh,
+    )
+    if args.resume and checkpointer is not None:
+        restored = checkpointer.restore(runner.get_state())
+        if restored is not None:
+            runner.set_state(restored)
+            print(
+                f"resumed @ step {runner.num_steps} "
+                f"({runner.num_frames} frames)",
+                file=sys.stderr,
+            )
+    # Budget semantics match the actor runtime: total_steps is the TOTAL
+    # budget; a resumed run performs only the remainder.
+    remaining = max(0, total_steps - runner.num_steps)
+
+    profile_ctx = None
+    if args.profile_dir:
+        profile_ctx = jax.profiler.trace(
+            args.profile_dir, create_perfetto_link=False
+        )
+        profile_ctx.__enter__()
+    logs = {}
+    t0 = _time.perf_counter()
+    try:
+        for _ in range(remaining):
+            logs = runner.step()
+            if args.log_every and runner.num_steps % args.log_every == 0:
+                host_logs = {k: float(v) for k, v in logs.items()}
+                host_logs["num_steps"] = runner.num_steps
+                host_logs["num_frames"] = runner.num_frames
+                logger(host_logs)
+            if (
+                checkpointer is not None
+                and args.checkpoint_interval
+                and runner.num_steps % args.checkpoint_interval == 0
+            ):
+                checkpointer.save(runner.num_steps, runner.get_state())
+    finally:
+        if profile_ctx is not None:
+            profile_ctx.__exit__(*sys.exc_info())
+        if checkpointer is not None:
+            if checkpointer.latest_step() != runner.num_steps:
+                checkpointer.save(runner.num_steps, runner.get_state())
+            checkpointer.close()
+        logger.close()
+    jax.block_until_ready(jax.tree.leaves(runner.params)[0])
+    dt = _time.perf_counter() - t0
+    fps = remaining * runner.frames_per_step / dt if dt > 0 else 0.0
+    ret = float(logs.get("episode_return_mean", float("nan")))
+    print(
+        f"done: steps={runner.num_steps} frames={runner.num_frames} "
+        f"frames_per_sec={fps:,.0f} episode_return_mean={ret:.2f}",
         file=sys.stderr,
     )
     return 0

@@ -1,6 +1,10 @@
 """Actor-learner runtime: actors, batcher, learner, param publication."""
 
 from torched_impala_tpu.runtime.actor import Actor  # noqa: F401
+from torched_impala_tpu.runtime.anakin import (  # noqa: F401
+    AnakinConfig,
+    AnakinRunner,
+)
 from torched_impala_tpu.runtime.env_pool import (  # noqa: F401
     ProcessEnvPool,
 )
@@ -27,6 +31,8 @@ from torched_impala_tpu.runtime.vector_actor import VectorActor  # noqa: F401
 __all__ = [
     "Actor",
     "ActorSupervisor",
+    "AnakinConfig",
+    "AnakinRunner",
     "EvalResult",
     "run_episodes",
     "Learner",

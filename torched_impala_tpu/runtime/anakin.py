@@ -1,0 +1,301 @@
+"""Anakin: the fully on-device actor-learner for pure-JAX envs.
+
+The host-actor runtime (runtime/loop.py) reproduces the reference's
+process-actor architecture: Python envs on host CPUs feeding a device
+learner through queues (SURVEY.md §2 Orchestration row). Anakin is the
+TPU-native fast path that architecture cannot reach: when the env itself
+is jax (envs/jax_envs.py), the ENTIRE iteration — E envs stepped in
+lockstep, batched policy sampling, trajectory assembly, V-trace loss,
+backward, optimizer update — is ONE jitted XLA program. No queues, no
+host↔device transfers, no Python in the loop; the rollout is a
+`lax.scan` over time with envs vmapped over the batch, exactly the
+"Podracer/Anakin" pattern (Hessel et al., arXiv:2104.06272).
+
+On-policy note: actors and learner share params inside one program, so
+the behaviour distribution equals the target distribution and V-trace's
+importance weights are identically 1 (it degrades to the lambda-return
+estimator). The full off-policy machinery still runs — same
+`impala_loss`, same nets — so switching a config between host actors and
+Anakin changes throughput, not semantics.
+
+Data parallelism: with a mesh, params/opt state are replicated and the
+env batch is sharded over the `data` axis; per-env RNG is derived by
+`fold_in(key, global env index)` so resharding never changes the random
+stream. XLA inserts the gradient all-reduce over ICI (parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.ops import vtrace as vtrace_ops
+from torched_impala_tpu.ops.losses import ImpalaLossConfig, impala_loss
+from torched_impala_tpu.parallel.mesh import (
+    DATA_AXIS,
+    replicated,
+    state_sharding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AnakinConfig:
+    num_envs: int  # E: global env batch (divisible by the data axis)
+    unroll_length: int  # T: steps per iteration
+    loss: ImpalaLossConfig = ImpalaLossConfig()
+
+
+class AnakinRunner:
+    """Owns (params, opt_state, env carry) and one compiled train program.
+
+    `step()` advances every env `unroll_length` steps and applies one SGD
+    update; `frames_per_step` = T * E. All state lives on device between
+    calls; only the log scalars ever reach the host (and only when read).
+    """
+
+    def __init__(
+        self,
+        *,
+        agent: Agent,
+        env,
+        optimizer: optax.GradientTransformation,
+        config: AnakinConfig,
+        rng: jax.Array,
+        mesh=None,
+    ) -> None:
+        self._agent = agent
+        self._env = env
+        self._optimizer = optimizer
+        self._config = config
+        self._mesh = mesh
+        E = config.num_envs
+        if mesh is not None and E % mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"num_envs {E} not divisible by data axis "
+                f"{mesh.shape[DATA_AXIS]}"
+            )
+        if config.loss.vtrace_implementation == "auto":
+            # Same device-aware resolution as runtime.Learner.
+            impl = vtrace_ops.resolve_implementation(
+                "auto",
+                mesh.devices.flat if mesh is not None else None,
+            )
+            self._config = dataclasses.replace(
+                config,
+                loss=dataclasses.replace(
+                    config.loss, vtrace_implementation=impl
+                ),
+            )
+
+        init_key, env_key, carry_key = jax.random.split(rng, 3)
+        env_state = jax.vmap(env.reset)(
+            jax.vmap(jax.random.fold_in, (None, 0))(env_key, jnp.arange(E))
+        )
+        example_obs = env.observe(jax.tree.map(lambda x: x[0], env_state))
+        self.params = agent.init_params(init_key, example_obs)
+        self.opt_state = optimizer.init(self.params)
+        self._carry = (
+            carry_key,
+            env_state,
+            jnp.ones((E,), jnp.bool_),
+            agent.initial_state(E),
+            jnp.zeros((E,), jnp.float32),  # running episode return
+        )
+        self.num_steps = 0
+        self.num_frames = 0
+
+        if mesh is None:
+            self._step_fn = jax.jit(
+                self._step_impl, donate_argnums=(0, 1, 2)
+            )
+        else:
+            rep = replicated(mesh)
+            ss = state_sharding(mesh)  # [E, ...] leaves over `data`
+            carry_shardings = (
+                rep,  # rng key: replicated; per-env keys use fold_in
+                jax.tree.map(lambda _: ss, self._carry[1]),
+                ss,
+                jax.tree.map(lambda _: ss, self._carry[3]),
+                ss,
+            )
+            self.params = jax.device_put(self.params, rep)
+            self.opt_state = jax.device_put(self.opt_state, rep)
+            self._carry = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                self._carry,
+                carry_shardings,
+                is_leaf=lambda x: isinstance(x, jax.Array),
+            )
+            self._step_fn = jax.jit(
+                self._step_impl,
+                donate_argnums=(0, 1, 2),
+                in_shardings=(rep, rep, carry_shardings),
+                out_shardings=(rep, rep, carry_shardings, rep),
+            )
+
+    @property
+    def frames_per_step(self) -> int:
+        return self._config.num_envs * self._config.unroll_length
+
+    # ---- checkpoint state ---------------------------------------------
+
+    def get_state(self) -> dict:
+        """Checkpointable state, same shape as Learner.get_state: params,
+        opt state, frame/step counters, and the CURRENT rollout rng (so a
+        restore continues the random stream instead of replaying it). Env
+        states are NOT checkpointed — like the actor runtime, envs restart
+        fresh on resume (episodes in flight are lost, counters are not)."""
+        import numpy as np
+
+        from torched_impala_tpu.utils.checkpoint import pack_rng
+
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "num_frames": np.asarray(self.num_frames, np.int64),
+            "num_steps": np.asarray(self.num_steps, np.int64),
+            "rng": pack_rng(self._carry[0]),
+        }
+
+    def set_state(self, state: Mapping[str, Any]) -> None:
+        from torched_impala_tpu.utils.checkpoint import unpack_rng
+
+        put = (
+            (lambda x: jax.device_put(x, replicated(self._mesh)))
+            if self._mesh is not None
+            else (lambda x: x)
+        )
+        self.params = put(state["params"])
+        self.opt_state = put(state["opt_state"])
+        self.num_frames = int(state["num_frames"])
+        self.num_steps = int(state["num_steps"])
+        self._carry = (put(unpack_rng(state["rng"])),) + self._carry[1:]
+
+    # ---- one fused XLA program ----------------------------------------
+
+    def _step_impl(self, params, opt_state, carry):
+        agent, env, cfg = self._agent, self._env, self._config.loss
+        T, E = self._config.unroll_length, self._config.num_envs
+        env_ids = jnp.arange(E)
+        start_state = carry[3]
+        observe = jax.vmap(env.observe)
+
+        def body(c, _):
+            key, env_state, first, agent_state, ep_ret = c
+            key, act_key, env_key, reset_key = jax.random.split(key, 4)
+            obs = observe(env_state)
+            out = agent.step(params, act_key, obs, first, agent_state)
+            env_keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                env_key, env_ids
+            )
+            next_state, reward, done = jax.vmap(env.step)(
+                env_state, out.action, env_keys
+            )
+            ep_ret = ep_ret + reward
+            completed_ret = jnp.where(done, ep_ret, 0.0)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            # Auto-reset finished envs; their next step carries first=True
+            # so the nets' reset-core zeroes the recurrent carry.
+            reset_keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                reset_key, env_ids
+            )
+            fresh_state = jax.vmap(env.reset)(reset_keys)
+
+            def pick(new, old):
+                d = done.reshape(done.shape + (1,) * (old.ndim - 1))
+                return jnp.where(d, new, old)
+
+            next_state = jax.tree.map(pick, fresh_state, next_state)
+            ys = (
+                obs,
+                first,
+                out.action,
+                out.policy_logits,
+                reward,
+                1.0 - done.astype(jnp.float32),
+                completed_ret,
+            )
+            return (key, next_state, done, out.state, ep_ret), ys
+
+        carry, ys = jax.lax.scan(body, carry, None, length=T)
+        obs_t, first_t, actions, behaviour_logits, rewards, cont, done_rets = ys
+        # Bootstrap entries: the state the rollout stopped in.
+        obs_full = jnp.concatenate([obs_t, observe(carry[1])[None]], axis=0)
+        first_full = jnp.concatenate([first_t, carry[2][None]], axis=0)
+
+        def loss_fn(p):
+            net_out, _ = agent.unroll(p, obs_full, first_full, start_state)
+            values = jnp.squeeze(net_out.values, -1)  # [T+1, E]
+            out = impala_loss(
+                target_logits=net_out.policy_logits[:-1],
+                behaviour_logits=behaviour_logits,
+                values=values[:-1],
+                bootstrap_value=values[-1],
+                actions=actions,
+                rewards=rewards,
+                discounts=cfg.discount * cont,
+                config=cfg,
+            )
+            return out.total, out.logs
+
+        (_, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = self._optimizer.update(
+            grads, opt_state, params
+        )
+        params = optax.apply_updates(params, updates)
+        logs = dict(logs)
+        # Episode stats from the completed-episode events inside this
+        # unroll. nan when the window finished no episodes (e.g. solved
+        # CartPole at T << 500) — 0.0 would read as a legitimate return.
+        finished = jnp.sum(1.0 - cont)
+        logs["episodes_finished"] = finished
+        logs["episode_return_mean"] = jnp.where(
+            finished > 0,
+            jnp.sum(done_rets) / jnp.maximum(finished, 1.0),
+            jnp.nan,
+        )
+        return params, opt_state, carry, logs
+
+    # ---- host-side driver ---------------------------------------------
+
+    def step(self) -> Mapping[str, Any]:
+        """One iteration: T steps of E envs + one SGD update, all on device."""
+        self.params, self.opt_state, self._carry, logs = self._step_fn(
+            self.params, self.opt_state, self._carry
+        )
+        self.num_steps += 1
+        self.num_frames += self.frames_per_step
+        return logs
+
+    def run(
+        self,
+        num_iterations: int,
+        *,
+        log_every: int = 0,
+        logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
+    ) -> Mapping[str, Any]:
+        """Run iterations; returns the final logs dict with throughput."""
+        logs: Mapping[str, Any] = {}
+        t0 = time.perf_counter()
+        for i in range(num_iterations):
+            logs = self.step()
+            if logger is not None and log_every and (i + 1) % log_every == 0:
+                host_logs = {k: float(v) for k, v in logs.items()}
+                host_logs["num_steps"] = self.num_steps
+                host_logs["num_frames"] = self.num_frames
+                logger(host_logs)
+        jax.block_until_ready(logs)
+        dt = time.perf_counter() - t0
+        out = {k: float(v) for k, v in logs.items()}
+        out["num_steps"] = self.num_steps
+        out["num_frames"] = self.num_frames
+        out["frames_per_sec"] = (
+            num_iterations * self.frames_per_step / dt if dt > 0 else 0.0
+        )
+        return out

@@ -32,6 +32,7 @@ from jax.sharding import Mesh
 
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.ops import popart as popart_ops
+from torched_impala_tpu.ops import vtrace as vtrace_ops
 from torched_impala_tpu.ops.losses import ImpalaLossConfig, impala_loss
 from torched_impala_tpu.ops.popart import PopArtConfig
 from torched_impala_tpu.parallel.mesh import (
@@ -127,11 +128,9 @@ class Learner:
             # backend, which is wrong for e.g. a CPU mesh built in a process
             # whose default backend is a TPU (the compiled Pallas kernel
             # would be lowered for CPU and fail).
-            devs = mesh.devices.flat if mesh is not None else jax.devices()
-            impl = (
-                "pallas"
-                if next(iter(devs)).platform == "tpu"
-                else "scan"
+            impl = vtrace_ops.resolve_implementation(
+                "auto",
+                mesh.devices.flat if mesh is not None else None,
             )
             config = dataclasses.replace(
                 config,
