@@ -140,6 +140,14 @@ def main() -> None:
     except Exception as e:
         log(f"bench: anakin bench failed: {type(e).__name__}: {e}")
         result["anakin_cartpole"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if tpu_ok:
+        try:
+            result["anakin_pixels"] = run_bench_anakin_pixels(jax)
+        except Exception as e:
+            log(f"bench: anakin pixels bench failed: {type(e).__name__}: {e}")
+            result["anakin_pixels"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]
+            }
     for mode in ("thread", "process"):
         try:
             result[f"e2e_{mode}"] = run_e2e(jax, tpu_ok, mode)
@@ -405,6 +413,51 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
     }
     log(
         f"bench: anakin E={E} T={T}: "
+        f"{out['frames_per_sec']:,.0f} env-frames/s on-device"
+    )
+    return result
+
+
+def run_bench_anakin_pixels(jax) -> dict:
+    """On-device throughput at Atari pixel shapes: JaxPixelSignal 84x84x4 +
+    bf16 Nature-CNN, rollout+train fused (runtime/anakin.py). The closest
+    apples-to-apples on-device comparison to the host-actor Pong pipeline:
+    same obs shape, same torso, same loss — but env stepping is on-chip."""
+    import jax.numpy as jnp
+    import optax
+
+    from torched_impala_tpu.envs import JaxPixelSignal
+    from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
+    from torched_impala_tpu.ops import ImpalaLossConfig
+    from torched_impala_tpu.runtime import AnakinConfig, AnakinRunner
+
+    E, T, iters = 128, 20, 20
+    runner = AnakinRunner(
+        agent=Agent(
+            ImpalaNet(
+                num_actions=4, torso=AtariShallowTorso(dtype=jnp.bfloat16)
+            )
+        ),
+        env=JaxPixelSignal(),  # 84x84x4
+        optimizer=optax.rmsprop(1e-3, decay=0.99, eps=1e-7),
+        config=AnakinConfig(
+            num_envs=E,
+            unroll_length=T,
+            loss=ImpalaLossConfig(reduction="mean"),
+        ),
+        rng=jax.random.key(0),
+    )
+    runner.step()  # compile
+    out = runner.run(iters)
+    result = {
+        "env_frames_per_sec": round(out["frames_per_sec"], 1),
+        "E": E,
+        "T": T,
+        "obs": "84x84x4 uint8",
+        "model": "nature_cnn_bf16",
+    }
+    log(
+        f"bench: anakin pixels E={E} T={T}: "
         f"{out['frames_per_sec']:,.0f} env-frames/s on-device"
     )
     return result

@@ -11,6 +11,8 @@ from torched_impala_tpu.envs.factory import (  # noqa: F401
 from torched_impala_tpu.envs.jax_envs import (  # noqa: F401
     JaxCartPole,
     JaxCatch,
+    JaxEnvGymWrapper,
+    JaxPixelSignal,
 )
 from torched_impala_tpu.envs.fake import (  # noqa: F401
     CrashingEnv,
@@ -31,6 +33,8 @@ __all__ = [
     "FakeDiscreteEnv",
     "JaxCartPole",
     "JaxCatch",
+    "JaxEnvGymWrapper",
+    "JaxPixelSignal",
     "ScriptedEnv",
     "make_atari",
     "make_cartpole",

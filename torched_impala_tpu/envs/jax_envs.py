@@ -107,6 +107,71 @@ class JaxCartPole:
         return CartPoleState(physics, t), reward, done
 
 
+class PixelSignalState(NamedTuple):
+    target: jax.Array  # [] int32 quadrant whose action pays reward
+    t: jax.Array  # [] int32 steps taken this episode
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxPixelSignal:
+    """Pure-JAX port of envs/fake.SignalEnv: a lit quadrant encodes the
+    rewarded action, fresh target every step, fixed-length episodes. Gives
+    the ON-DEVICE (Anakin) path a conv-pipeline learning signal at
+    Atari-like pixel shapes — random policy averages episode_len/4 return,
+    a policy that reads the pixels approaches episode_len."""
+
+    size: int = 84
+    channels: int = 4
+    episode_len: int = 20
+
+    num_actions: int = 4
+    obs_dtype = jnp.uint8
+
+    @property
+    def obs_shape(self) -> tuple:
+        return (self.size, self.size, self.channels)
+
+    def reset(self, key: jax.Array) -> PixelSignalState:
+        return PixelSignalState(
+            target=jax.random.randint(key, (), 0, self.num_actions).astype(
+                jnp.int32
+            ),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: PixelSignalState) -> jax.Array:
+        h = self.size // 2
+        r, c = state.target // 2, state.target % 2
+        rows = jnp.arange(self.size)[:, None]
+        cols = jnp.arange(self.size)[None, :]
+        lit = (
+            (rows >= r * h)
+            & (rows < (r + 1) * h)
+            & (cols >= c * h)
+            & (cols < (c + 1) * h)
+        )
+        frame = jnp.where(lit, jnp.uint8(255), jnp.uint8(0))
+        return jnp.broadcast_to(
+            frame[:, :, None], (self.size, self.size, self.channels)
+        )
+
+    def step(
+        self, state: PixelSignalState, action: jax.Array, key: jax.Array
+    ) -> tuple[PixelSignalState, jax.Array, jax.Array]:
+        reward = (action.astype(jnp.int32) == state.target).astype(
+            jnp.float32
+        )
+        t = state.t + 1
+        new_target = jax.random.randint(
+            key, (), 0, self.num_actions
+        ).astype(jnp.int32)
+        return (
+            PixelSignalState(target=new_target, t=t),
+            reward,
+            t >= self.episode_len,
+        )
+
+
 class JaxEnvGymWrapper:
     """gymnasium-API adapter over any JaxEnv: host-side stepping for the
     eval runner and the host-actor path, so an Anakin-trained policy can be
