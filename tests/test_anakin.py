@@ -136,7 +136,8 @@ def test_conv_policy_learns_pixels_on_device():
     early = runner.run(10)
     late = runner.run(120)
     # Random policy averages episode_len/4 = 2.5; reading the pixels
-    # approaches 10.
+    # approaches 10 (the cap — keep the relative bound satisfiable even
+    # if early learning is fast).
     assert late["episode_return_mean"] > max(
-        4.0, early["episode_return_mean"] * 1.3
+        4.0, min(early["episode_return_mean"] * 1.3, 8.0)
     ), (early["episode_return_mean"], late["episode_return_mean"])

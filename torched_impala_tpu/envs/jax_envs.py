@@ -127,6 +127,15 @@ class JaxPixelSignal:
     num_actions: int = 4
     obs_dtype = jnp.uint8
 
+    def __post_init__(self):
+        # Targets are encoded as 2x2 quadrants (same constraint as the
+        # numpy SignalEnv); more actions would render invisible targets.
+        if self.num_actions > 4:
+            raise ValueError(
+                f"num_actions {self.num_actions} > 4: targets are encoded "
+                "as 2x2 quadrants"
+            )
+
     @property
     def obs_shape(self) -> tuple:
         return (self.size, self.size, self.channels)
