@@ -9,6 +9,7 @@ from torched_impala_tpu.ops import vtrace  # noqa: F401  (submodule)
 from torched_impala_tpu.ops.vtrace import (  # noqa: F401
     VTraceOutput,
     importance_ratios,
+    resolve_implementation,
     vtrace_scan,
 )
 from torched_impala_tpu.ops.vtrace import vtrace as vtrace_fn  # noqa: F401
@@ -34,6 +35,7 @@ __all__ = [
     "popart_impala_loss",
     "VTraceOutput",
     "importance_ratios",
+    "resolve_implementation",
     "vtrace",
     "vtrace_fn",
     "vtrace_scan",

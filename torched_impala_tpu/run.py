@@ -166,6 +166,11 @@ def main(argv=None) -> int:
                 checkpointer.close()
 
     if cfg.runtime == "anakin":
+        if args.coordinator or args.num_hosts:
+            raise SystemExit(
+                "runtime='anakin' is single-controller (multi-host needs "
+                "the actor runtime); drop --coordinator/--num-hosts"
+            )
         return run_anakin(args, cfg, agent, mesh, checkpointer)
 
     learner_config = configs.make_learner_config(cfg)
