@@ -136,6 +136,13 @@ def main() -> None:
             result["learner_deep_breakout"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]
             }
+        try:
+            result["learner_scaling"] = run_bench_scaling(jax)
+        except Exception as e:
+            log(f"bench: scaling bench failed: {type(e).__name__}: {e}")
+            result["learner_scaling"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]
+            }
     if tpu_ok:
         try:
             result["vtrace_pallas_vs_scan"] = run_vtrace_kernel_compare(jax)
@@ -382,6 +389,72 @@ def run_bench_deep(jax) -> dict:
     except Exception as e:
         log(f"bench: deep cost_analysis unavailable: {type(e).__name__}: {e}")
     log(f"bench: deep learner {steps} steps in {dt:.3f}s -> {fps:,.0f} f/s")
+    return out
+
+
+def run_bench_scaling(jax) -> dict:
+    """Learner frames/s/chip vs batch size at the Pong config (T=20, bf16
+    Nature-CNN): shows how far the single-chip number scales past the
+    B=256 headline before HBM/MXU saturate. TPU-only."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
+    from torched_impala_tpu.ops import ImpalaLossConfig
+    from torched_impala_tpu.runtime import Learner, LearnerConfig
+
+    T, num_actions, steps = 20, 6, 15
+    out = {}
+    for B in (64, 256, 1024):
+        agent = Agent(
+            ImpalaNet(
+                num_actions=num_actions,
+                torso=AtariShallowTorso(dtype=jnp.bfloat16),
+            )
+        )
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
+            config=LearnerConfig(
+                batch_size=B,
+                unroll_length=T,
+                loss=ImpalaLossConfig(reduction="sum"),
+                publish_interval=1_000_000,
+            ),
+            example_obs=np.zeros((84, 84, 4), np.uint8),
+            rng=jax.random.key(0),
+        )
+        rng = np.random.default_rng(0)
+        arrays = jax.device_put((
+            jnp.asarray(
+                rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
+            ),
+            jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
+            jnp.asarray(
+                rng.integers(0, num_actions, size=(T, B), dtype=np.int32)
+            ),
+            jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
+            jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+            jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            (),
+        ))
+        params, opt_state, pa = learner.params, learner.opt_state, ()
+        step_fn = learner._train_step.lower(
+            params, opt_state, pa, *arrays
+        ).compile()
+        params, opt_state, pa, logs = step_fn(params, opt_state, pa, *arrays)
+        jax.block_until_ready(logs)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, pa, logs = step_fn(
+                params, opt_state, pa, *arrays
+            )
+        jax.block_until_ready(logs)
+        dt = time.perf_counter() - t0
+        out[f"B{B}"] = round(T * B * steps / dt, 1)
+        log(f"bench: scaling B={B}: {out[f'B{B}']:,.0f} frames/s")
     return out
 
 
