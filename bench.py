@@ -126,50 +126,31 @@ def main() -> None:
     except Exception as e:
         log(f"bench: compilation cache unavailable: {e}")
     result = run_bench(jax, tpu_ok)
+
+    def section(key, fn, *, gate=True):
+        """Extras must not kill the primary metric: failures become an
+        `error` value under the section's key."""
+        if not gate:
+            return
+        try:
+            result[key] = fn()
+        except Exception as e:
+            log(f"bench: {key} failed: {type(e).__name__}: {e}")
+            result[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # Cheap, high-value TPU sections first so a slow e2e (host-bound on a
     # low-core box) hitting the wall-clock alarm can't starve them.
-    if tpu_ok:
-        try:
-            result["learner_deep_breakout"] = run_bench_deep(jax)
-        except Exception as e:
-            log(f"bench: deep learner bench failed: {type(e).__name__}: {e}")
-            result["learner_deep_breakout"] = {
-                "error": f"{type(e).__name__}: {e}"[:300]
-            }
-        try:
-            result["learner_scaling"] = run_bench_scaling(jax)
-        except Exception as e:
-            log(f"bench: scaling bench failed: {type(e).__name__}: {e}")
-            result["learner_scaling"] = {
-                "error": f"{type(e).__name__}: {e}"[:300]
-            }
-    if tpu_ok:
-        try:
-            result["vtrace_pallas_vs_scan"] = run_vtrace_kernel_compare(jax)
-        except Exception as e:
-            log(f"bench: kernel compare failed: {type(e).__name__}: {e}")
-            result["vtrace_pallas_vs_scan"] = {
-                "error": f"{type(e).__name__}: {e}"[:300]
-            }
-    try:
-        result["anakin_cartpole"] = run_bench_anakin(jax, tpu_ok)
-    except Exception as e:
-        log(f"bench: anakin bench failed: {type(e).__name__}: {e}")
-        result["anakin_cartpole"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-    if tpu_ok:
-        try:
-            result["anakin_pixels"] = run_bench_anakin_pixels(jax)
-        except Exception as e:
-            log(f"bench: anakin pixels bench failed: {type(e).__name__}: {e}")
-            result["anakin_pixels"] = {
-                "error": f"{type(e).__name__}: {e}"[:300]
-            }
+    section("learner_deep_breakout", lambda: run_bench_deep(jax), gate=tpu_ok)
+    section("learner_scaling", lambda: run_bench_scaling(jax), gate=tpu_ok)
+    section(
+        "vtrace_pallas_vs_scan",
+        lambda: run_vtrace_kernel_compare(jax),
+        gate=tpu_ok,
+    )
+    section("anakin_cartpole", lambda: run_bench_anakin(jax, tpu_ok))
+    section("anakin_pixels", lambda: run_bench_anakin_pixels(jax), gate=tpu_ok)
     for mode in ("thread", "process"):
-        try:
-            result[f"e2e_{mode}"] = run_e2e(jax, tpu_ok, mode)
-        except Exception as e:  # e2e extras must not kill the primary metric
-            log(f"bench: e2e {mode} failed: {type(e).__name__}: {e}")
-            result[f"e2e_{mode}"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
     try:
         result["batcher_numpy_vs_native"] = run_batcher_compare()
     except Exception as e:
@@ -177,75 +158,109 @@ def main() -> None:
     print(json.dumps(result))
 
 
-def run_bench(jax, tpu_ok: bool) -> None:
+class _LearnerFixture:
+    """One AOT-compiled synthetic-data learner step: shared scaffolding for
+    every learner-throughput section (primary Pong, deep flagship, batch
+    scaling). Data is device-resident; host publication is excluded via a
+    huge publish_interval; the executable is compiled ONCE and reused for
+    warmup, timing, trace capture, and cost_analysis."""
+
+    def __init__(self, jax, *, torso, num_actions, T, B, use_lstm=False):
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from torched_impala_tpu.models import Agent, ImpalaNet
+        from torched_impala_tpu.ops import ImpalaLossConfig
+        from torched_impala_tpu.runtime import Learner, LearnerConfig
+
+        self.jax, self.T, self.B = jax, T, B
+        agent = Agent(
+            ImpalaNet(num_actions=num_actions, torso=torso, use_lstm=use_lstm)
+        )
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
+            config=LearnerConfig(
+                batch_size=B,
+                unroll_length=T,
+                loss=ImpalaLossConfig(reduction="sum"),
+                publish_interval=1_000_000,
+            ),
+            example_obs=np.zeros((84, 84, 4), np.uint8),
+            rng=jax.random.key(0),
+        )
+        rng = np.random.default_rng(0)
+        self._arrays = jax.device_put((
+            jnp.asarray(
+                rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
+            ),
+            jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
+            jnp.asarray(
+                rng.integers(0, num_actions, size=(T, B), dtype=np.int32)
+            ),
+            jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
+            jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+            jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            agent.initial_state(B) if use_lstm else (),
+        ))
+        self._state = (learner.params, learner.opt_state, ())
+        self.step_fn = learner._train_step.lower(
+            *self._state, *self._arrays
+        ).compile()
+        # Warmup (first real execution).
+        self.logs = self.run_steps(1)
+
+    def run_steps(self, steps: int):
+        """Run `steps` chained updates; blocks, returns the final logs."""
+        state, logs = self._state, None
+        for _ in range(steps):
+            *state, logs = self.step_fn(*state, *self._arrays)
+        self.jax.block_until_ready(logs)
+        self._state = tuple(state)
+        return logs
+
+    def timed_frames_per_sec(self, steps: int) -> tuple:
+        t0 = time.perf_counter()
+        self.run_steps(steps)
+        dt = time.perf_counter() - t0
+        return self.T * self.B * steps / dt, dt
+
+    def flops_per_step(self) -> float:
+        """XLA's algebraic FLOP count for one compiled step (0 if absent)."""
+        try:
+            cost = self.step_fn.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return float(cost.get("flops", 0.0))
+        except Exception as e:
+            log(f"bench: cost_analysis unavailable: {type(e).__name__}: {e}")
+            return 0.0
+
+
+def run_bench(jax, tpu_ok: bool) -> dict:
     import jax.numpy as jnp
-    import numpy as np
-    import optax
-    from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
-    from torched_impala_tpu.ops import ImpalaLossConfig
-    from torched_impala_tpu.runtime import Learner, LearnerConfig
+
+    from torched_impala_tpu.models import AtariShallowTorso
 
     # Full Pong shapes on TPU; a reduced batch on the CPU fallback so the
     # run finishes in minutes (the number is labeled non-comparable anyway).
     T, B = (20, 256) if tpu_ok else (20, 32)
-    num_actions = 6  # Pong
     log(f"bench: backend={jax.default_backend()} T={T} B={B}")
-
-    agent = Agent(
-        ImpalaNet(
-            num_actions=num_actions,
-            # bf16 torso matches the pong preset (configs.py): conv FLOPs
-            # on the MXU fast path, heads/loss in f32.
-            torso=AtariShallowTorso(dtype=jnp.bfloat16),
-        )
+    # bf16 torso matches the pong preset (configs.py): conv FLOPs on the
+    # MXU fast path, heads/loss in f32.
+    fx = _LearnerFixture(
+        jax,
+        torso=AtariShallowTorso(dtype=jnp.bfloat16),
+        num_actions=6,  # Pong
+        T=T,
+        B=B,
     )
-    learner = Learner(
-        agent=agent,
-        optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
-        config=LearnerConfig(
-            batch_size=B,
-            unroll_length=T,
-            loss=ImpalaLossConfig(reduction="sum"),
-            publish_interval=1_000_000,  # exclude host publication from timing
-        ),
-        example_obs=np.zeros((84, 84, 4), np.uint8),
-        rng=jax.random.key(0),
-    )
-
-    rng = np.random.default_rng(0)
-    arrays = (
-        jnp.asarray(
-            rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
-        ),
-        jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
-        jnp.asarray(rng.integers(0, num_actions, size=(T, B), dtype=np.int32)),
-        jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
-        jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
-        jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
-        jnp.zeros((B,), jnp.int32),  # task ids (single-task)
-        (),
-    )
-    arrays = jax.device_put(arrays)
-
-    params, opt_state, pa = learner.params, learner.opt_state, ()
-    # AOT: lower+compile ONCE and reuse the executable for warmup, timing,
-    # trace capture, and cost_analysis (a second .lower().compile() would
-    # not share the jit cache and recompiles the whole program).
-    step_fn = learner._train_step.lower(
-        params, opt_state, pa, *arrays
-    ).compile()
-    params, opt_state, pa, logs = step_fn(params, opt_state, pa, *arrays)
-    jax.block_until_ready(logs)
-    log(f"bench: compiled, total_loss={float(logs['total_loss']):.3f}")
+    log(f"bench: compiled, total_loss={float(fx.logs['total_loss']):.3f}")
 
     steps = 30 if tpu_ok else 5
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, pa, logs = step_fn(
-            params, opt_state, pa, *arrays
-        )
-    jax.block_until_ready(logs)
-    dt = time.perf_counter() - t0
+    frames_per_sec, dt = fx.timed_frames_per_sec(steps)
 
     trace_dir = None
     if tpu_ok:
@@ -255,17 +270,12 @@ def run_bench(jax, tpu_ok: bool) -> None:
         try:
             trace_dir = os.path.join(REPO, "traces", "bench")
             with jax.profiler.trace(trace_dir, create_perfetto_link=False):
-                for _ in range(5):
-                    params, opt_state, pa, logs = step_fn(
-                        params, opt_state, pa, *arrays
-                    )
-                jax.block_until_ready(logs)
+                fx.run_steps(5)
             log(f"bench: profiler trace captured in {trace_dir}")
         except Exception as e:
             log(f"bench: trace capture failed: {type(e).__name__}: {e}")
             trace_dir = None
 
-    frames_per_sec = T * B * steps / dt
     n_chips = max(1, len(jax.devices()))
     value = frames_per_sec / n_chips
     result = {
@@ -281,22 +291,13 @@ def run_bench(jax, tpu_ok: bool) -> None:
     }
     if trace_dir is not None:
         result["profile_trace_dir"] = trace_dir
-    try:
-        # XLA's own FLOP count for the compiled train step -> rough MFU
-        # against the v5e bf16 peak (197 TFLOP/s/chip). "Rough": XLA counts
-        # algebraic flops, not MXU-padded ones.
-        cost = step_fn.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        if flops > 0:
-            result["train_step_gflops"] = round(flops / 1e9, 2)
-            if tpu_ok:
-                result["mfu_estimate"] = round(
-                    (flops * steps / dt) / 197e12, 4
-                )
-    except Exception as e:
-        log(f"bench: cost_analysis unavailable: {type(e).__name__}: {e}")
+    # Rough MFU vs the v5e bf16 peak (197 TFLOP/s/chip): XLA counts
+    # algebraic flops, not MXU-padded ones.
+    flops = fx.flops_per_step()
+    if flops > 0:
+        result["train_step_gflops"] = round(flops / 1e9, 2)
+        if tpu_ok:
+            result["mfu_estimate"] = round((flops * steps / dt) / 197e12, 4)
     if not tpu_ok:
         result["note"] = (
             "TPU tunnel unreachable at bench time; CPU fallback number — "
@@ -316,78 +317,29 @@ def run_bench_deep(jax) -> dict:
     Breakout/DMLab presets actually train. TPU-only (skipped on the CPU
     fallback — the deep stack takes minutes to compile there)."""
     import jax.numpy as jnp
-    import numpy as np
-    import optax
 
-    from torched_impala_tpu.models import Agent, AtariDeepTorso, ImpalaNet
-    from torched_impala_tpu.ops import ImpalaLossConfig
-    from torched_impala_tpu.runtime import Learner, LearnerConfig
+    from torched_impala_tpu.models import AtariDeepTorso
 
-    T, B, num_actions = 20, 32, 4
-    agent = Agent(
-        ImpalaNet(
-            num_actions=num_actions,
-            torso=AtariDeepTorso(dtype=jnp.bfloat16),
-            use_lstm=True,
-        )
+    T, B, steps = 20, 32, 30
+    fx = _LearnerFixture(
+        jax,
+        torso=AtariDeepTorso(dtype=jnp.bfloat16),
+        num_actions=4,
+        T=T,
+        B=B,
+        use_lstm=True,
     )
-    learner = Learner(
-        agent=agent,
-        optimizer=optax.rmsprop(4e-4, decay=0.99, eps=1e-7),
-        config=LearnerConfig(
-            batch_size=B,
-            unroll_length=T,
-            loss=ImpalaLossConfig(reduction="sum"),
-            publish_interval=1_000_000,
-        ),
-        example_obs=np.zeros((84, 84, 4), np.uint8),
-        rng=jax.random.key(0),
-    )
-    rng = np.random.default_rng(0)
-    arrays = (
-        jnp.asarray(
-            rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
-        ),
-        jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
-        jnp.asarray(rng.integers(0, num_actions, size=(T, B), dtype=np.int32)),
-        jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
-        jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
-        jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
-        jnp.zeros((B,), jnp.int32),
-        agent.initial_state(B),
-    )
-    arrays = jax.device_put(arrays)
-    params, opt_state, pa = learner.params, learner.opt_state, ()
-    step_fn = learner._train_step.lower(
-        params, opt_state, pa, *arrays
-    ).compile()  # AOT: one compile shared with timing + cost_analysis
-    params, opt_state, pa, logs = step_fn(params, opt_state, pa, *arrays)
-    jax.block_until_ready(logs)
-    steps = 30
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, pa, logs = step_fn(
-            params, opt_state, pa, *arrays
-        )
-    jax.block_until_ready(logs)
-    dt = time.perf_counter() - t0
-    fps = T * B * steps / dt
+    fps, dt = fx.timed_frames_per_sec(steps)
     out = {
         "frames_per_sec_per_chip": round(fps, 1),
         "model": "deep_resnet+lstm256",
         "T": T,
         "B": B,
     }
-    try:
-        cost = step_fn.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        if flops > 0:
-            out["train_step_gflops"] = round(flops / 1e9, 2)
-            out["mfu_estimate"] = round((flops * steps / dt) / 197e12, 4)
-    except Exception as e:
-        log(f"bench: deep cost_analysis unavailable: {type(e).__name__}: {e}")
+    flops = fx.flops_per_step()
+    if flops > 0:
+        out["train_step_gflops"] = round(flops / 1e9, 2)
+        out["mfu_estimate"] = round((flops * steps / dt) / 197e12, 4)
     log(f"bench: deep learner {steps} steps in {dt:.3f}s -> {fps:,.0f} f/s")
     return out
 
@@ -397,63 +349,20 @@ def run_bench_scaling(jax) -> dict:
     Nature-CNN): shows how far the single-chip number scales past the
     B=256 headline before HBM/MXU saturate. TPU-only."""
     import jax.numpy as jnp
-    import numpy as np
-    import optax
 
-    from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
-    from torched_impala_tpu.ops import ImpalaLossConfig
-    from torched_impala_tpu.runtime import Learner, LearnerConfig
+    from torched_impala_tpu.models import AtariShallowTorso
 
-    T, num_actions, steps = 20, 6, 15
     out = {}
     for B in (64, 256, 1024):
-        agent = Agent(
-            ImpalaNet(
-                num_actions=num_actions,
-                torso=AtariShallowTorso(dtype=jnp.bfloat16),
-            )
+        fx = _LearnerFixture(
+            jax,
+            torso=AtariShallowTorso(dtype=jnp.bfloat16),
+            num_actions=6,
+            T=20,
+            B=B,
         )
-        learner = Learner(
-            agent=agent,
-            optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
-            config=LearnerConfig(
-                batch_size=B,
-                unroll_length=T,
-                loss=ImpalaLossConfig(reduction="sum"),
-                publish_interval=1_000_000,
-            ),
-            example_obs=np.zeros((84, 84, 4), np.uint8),
-            rng=jax.random.key(0),
-        )
-        rng = np.random.default_rng(0)
-        arrays = jax.device_put((
-            jnp.asarray(
-                rng.integers(0, 256, size=(T + 1, B, 84, 84, 4), dtype=np.uint8)
-            ),
-            jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.01),
-            jnp.asarray(
-                rng.integers(0, num_actions, size=(T, B), dtype=np.int32)
-            ),
-            jnp.asarray(rng.normal(size=(T, B, num_actions)), jnp.float32),
-            jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
-            jnp.asarray((rng.uniform(size=(T, B)) > 0.01), jnp.float32),
-            jnp.zeros((B,), jnp.int32),
-            (),
-        ))
-        params, opt_state, pa = learner.params, learner.opt_state, ()
-        step_fn = learner._train_step.lower(
-            params, opt_state, pa, *arrays
-        ).compile()
-        params, opt_state, pa, logs = step_fn(params, opt_state, pa, *arrays)
-        jax.block_until_ready(logs)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, pa, logs = step_fn(
-                params, opt_state, pa, *arrays
-            )
-        jax.block_until_ready(logs)
-        dt = time.perf_counter() - t0
-        out[f"B{B}"] = round(T * B * steps / dt, 1)
+        fps, _ = fx.timed_frames_per_sec(15)
+        out[f"B{B}"] = round(fps, 1)
         log(f"bench: scaling B={B}: {out[f'B{B}']:,.0f} frames/s")
     return out
 

@@ -34,6 +34,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class CartPoleState(NamedTuple):
@@ -216,16 +217,12 @@ class JaxEnvGymWrapper:
         return sub
 
     def reset(self, seed=None):
-        import numpy as np
-
         if seed is not None:
             self._key = self._make_key(seed)
         self._state = self._reset(self._split())
         return np.asarray(self._observe(self._state)), {}
 
     def step(self, action):
-        import numpy as np
-
         self._state, reward, done = self._step(
             self._state, np.asarray(action, np.int32), self._split()
         )

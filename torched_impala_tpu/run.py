@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import sys
+import time
 
 import jax
 import numpy as np
@@ -253,8 +254,6 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
     --checkpoint-interval (plus a final save, crash-safe via finally), and
     --profile-dir like the actor runtime; env states are not checkpointed
     (envs restart fresh on resume, exactly as host envs do)."""
-    import time as _time
-
     from torched_impala_tpu import configs
     from torched_impala_tpu.runtime import AnakinConfig, AnakinRunner
 
@@ -303,7 +302,7 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
         )
         profile_ctx.__enter__()
     logs = {}
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     try:
         for _ in range(remaining):
             logs = runner.step()
@@ -327,7 +326,7 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
             checkpointer.close()
         logger.close()
     jax.block_until_ready(jax.tree.leaves(runner.params)[0])
-    dt = _time.perf_counter() - t0
+    dt = time.perf_counter() - t0
     fps = remaining * runner.frames_per_step / dt if dt > 0 else 0.0
     ret = float(logs.get("episode_return_mean", float("nan")))
     print(
