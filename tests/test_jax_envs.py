@@ -91,3 +91,35 @@ class TestJaxCatch:
         while not done:
             state, reward, done = env.step(state, jnp.asarray(0), key)
         assert float(reward) == -1.0
+
+
+def test_jax_env_through_host_actor_runtime():
+    """The same pure-JAX MDP trains through the HOST actor runtime via the
+    gym adapter (configs routes env_family='jax_*' there), completing the
+    'switch runtimes, keep the MDP' story."""
+    import optax
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.ops import ImpalaLossConfig
+    from torched_impala_tpu.runtime import LearnerConfig
+    from torched_impala_tpu.runtime.loop import train
+
+    cfg = configs.REGISTRY["catch_anakin"]
+    seen = []
+    result = train(
+        agent=configs.make_agent(cfg),
+        env_factory=configs.make_env_factory(cfg),
+        example_obs=configs.example_obs(cfg),
+        num_actors=2,
+        learner_config=LearnerConfig(
+            batch_size=2,
+            unroll_length=6,
+            loss=ImpalaLossConfig(reduction="mean"),
+        ),
+        optimizer=optax.sgd(1e-3),
+        total_steps=2,
+        logger=seen.append,
+        log_every=1,
+    )
+    assert result.learner.num_steps == 2
+    assert seen and np.isfinite(float(seen[-1]["total_loss"]))
