@@ -252,3 +252,44 @@ class TestPooledVectorActor:
 
         for leaf in jax.tree.leaves(result.learner.params):
             assert leaf.sharding.is_fully_replicated
+
+
+class TestPoolRepairPaths:
+    def test_reset_all_restarts_episodes_mid_flight(self):
+        """A respawned inference actor re-attaches via reset_all(): envs
+        must TRULY reset (ScriptedEnv's step counter back to 0), not just
+        hand back mid-episode observations labeled as episode starts."""
+        pool = make_pool()
+        try:
+            pool.reset_all()
+            obs, _, _, _ = pool.step_all(np.zeros(6))
+            np.testing.assert_array_equal(obs[:, 0], 1)  # mid-episode
+            obs = pool.reset_all()
+            np.testing.assert_array_equal(obs[:, 0], 0)  # real restart
+            # And stepping continues normally afterwards.
+            obs, rewards, dones, _ = pool.step_all(np.zeros(6))
+            np.testing.assert_array_equal(obs[:, 0], 1)
+            np.testing.assert_array_equal(rewards, 1.0)
+            assert not dones.any()
+        finally:
+            pool.close()
+
+    def test_abrupt_worker_death_repaired_on_send(self):
+        """SIGKILLing a worker between rounds (OOM-style) must repair
+        through the pool's restart path at the next send, not crash the
+        inference actor with BrokenPipeError."""
+        pool = make_pool()
+        try:
+            pool.reset_all()
+            pool.step_all(np.zeros(6))
+            pool._procs[0].kill()
+            pool._procs[0].join(timeout=10)
+            obs, rewards, dones, _ = pool.step_all(np.zeros(6))
+            assert pool.restarts >= 1
+            # The dead worker's rows are clean episode boundaries...
+            assert dones[:3].all()
+            np.testing.assert_array_equal(obs[:3, 0], 0)
+            # ...and the healthy worker's rows kept stepping.
+            np.testing.assert_array_equal(obs[3:, 0], 2)
+        finally:
+            pool.close()

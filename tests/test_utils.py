@@ -235,3 +235,26 @@ def test_checkpoint_rng_in_state(tmp_path):
     )
     assert int(restored["n"]) == 5
     ck.close()
+
+
+def test_restore_tolerates_checkpoint_without_rng(tmp_path):
+    """Back-compat: checkpoints written before the 'rng' entry existed must
+    still restore into an rng-bearing target (set_state treats rng as
+    optional)."""
+    import jax
+
+    from torched_impala_tpu.utils.checkpoint import Checkpointer, pack_rng
+
+    old = Checkpointer(str(tmp_path / "ck"))
+    state = {"params": np.arange(4.0), "num_steps": np.asarray(3)}
+    old.save(1, state)
+    old.close()
+
+    new = Checkpointer(str(tmp_path / "ck"))
+    target = dict(state)
+    target["rng"] = pack_rng(jax.random.key(0))
+    restored = new.restore(target)
+    new.close()
+    assert restored is not None and "rng" not in restored
+    np.testing.assert_array_equal(restored["params"], state["params"])
+    assert int(restored["num_steps"]) == 3

@@ -33,6 +33,26 @@ class EnvSpec:
     obs_dtype: np.dtype
 
 
+def call_env_factory(factory: Callable, seed: int, env_index=None):
+    """Invoke a `(seed)` or `(seed, env_index)` env factory uniformly.
+
+    The runtime passes an explicit global env index so multi-task presets
+    cover every task regardless of seed strides (round-1 advisor finding);
+    legacy single-arg factories are still accepted. ONE implementation of
+    the signature sniffing — the thread loop, the process-pool worker, and
+    the chaos wrapper all call this (one of them from a spawned child, so
+    keep this module import-light)."""
+    import inspect
+
+    try:
+        takes_index = len(inspect.signature(factory).parameters) >= 2
+    except (TypeError, ValueError):
+        takes_index = False
+    if takes_index:
+        return factory(seed, env_index)
+    return factory(seed)
+
+
 def make_cartpole(seed: int = 0, task: int = 0):
     import gymnasium
 

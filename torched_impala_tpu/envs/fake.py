@@ -181,19 +181,10 @@ class CrashingFactory:
         self.crash_after = crash_after
 
     def __call__(self, seed: int, env_index=None):
-        import inspect
 
-        try:
-            takes_index = (
-                len(inspect.signature(self.inner).parameters) >= 2
-            )
-        except (TypeError, ValueError):
-            takes_index = False
-        env = (
-            self.inner(seed, env_index)
-            if takes_index
-            else self.inner(seed)
-        )
+        from torched_impala_tpu.envs.factory import call_env_factory
+
+        env = call_env_factory(self.inner, seed, env_index)
         return CrashingEnv(env, crash_after=self.crash_after)
 
 

@@ -204,13 +204,17 @@ class JaxEnvGymWrapper:
         self.num_actions = env.num_actions
 
     def _make_key(self, seed):
-        # Build the key ON the host device: a bare jax.random.key would
-        # materialize on the default backend first (see vector_actor.py on
-        # why stray default-device arrays are poison on tunnelled TPUs).
+        # Create ON the host device (default_device keeps the materializing
+        # op off a tunnelled accelerator) and then COMMIT it (device_put) —
+        # an uncommitted array leaves per-call device selection to the
+        # default backend, so every subsequent split/reset/step would still
+        # dispatch to the TPU (see vector_actor.py on the cost). A
+        # committed key makes the whole per-step chain follow it to CPU.
         if self._device is None:
             return jax.random.key(seed)
         with jax.default_device(self._device):
-            return jax.random.key(seed)
+            key = jax.random.key(seed)
+        return jax.device_put(key, self._device)
 
     def _split(self):
         self._key, sub = jax.random.split(self._key)

@@ -189,8 +189,7 @@ def vtrace(
         clip_pg_rho_threshold=clip_pg_rho_threshold,
         lambda_=lambda_,
     )
-    if implementation == "auto":
-        implementation = "pallas" if _default_backend_is_tpu() else "scan"
+    implementation = resolve_implementation(implementation)
     if implementation == "scan":
         return vtrace_scan(**kwargs)
     if implementation == "pallas":

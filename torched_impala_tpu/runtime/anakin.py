@@ -150,14 +150,18 @@ class AnakinRunner:
         opt state, frame/step counters, and the CURRENT rollout rng (so a
         restore continues the random stream instead of replaying it). Env
         states are NOT checkpointed — like the actor runtime, envs restart
-        fresh on resume (episodes in flight are lost, counters are not)."""
+        fresh on resume (episodes in flight are lost, counters are not).
+
+        Host SNAPSHOTS, not live device arrays: the next step() donates
+        params/opt_state, which would invalidate buffers an async orbax
+        save is still reading (same hazard Learner.get_state documents)."""
         import numpy as np
 
         from torched_impala_tpu.utils.checkpoint import pack_rng
 
         return {
-            "params": self.params,
-            "opt_state": self.opt_state,
+            "params": jax.tree.map(np.asarray, self.params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
             "num_frames": np.asarray(self.num_frames, np.int64),
             "num_steps": np.asarray(self.num_steps, np.int64),
             "rng": pack_rng(self._carry[0]),

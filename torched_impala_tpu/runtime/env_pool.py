@@ -71,31 +71,42 @@ def _worker_main(
             buffer=shm.buf[shm_offset : shm_offset + nbytes],
         )
         factory = pickle.loads(factory_bytes)
-        try:
-            import inspect
-
-            takes_index = len(inspect.signature(factory).parameters) >= 2
-        except (TypeError, ValueError):
-            takes_index = False
+        from torched_impala_tpu.envs.factory import call_env_factory
 
         def build(i: int):
-            if takes_index:
-                return factory(base_seed + i, first_env_index + i)
-            return factory(base_seed + i)
+            return call_env_factory(
+                factory, base_seed + i, first_env_index + i
+            )
 
         envs = [build(i) for i in range(num_envs)]
         task_ids = [int(getattr(e, "task_id", 0)) for e in envs]
-        for i, env in enumerate(envs):
-            obs, _ = env.reset(seed=base_seed + i)
-            obs_block[i] = np.asarray(obs)
         ep_return = np.zeros((num_envs,), np.float64)
         ep_len = np.zeros((num_envs,), np.int64)
+
+        def reset_envs() -> None:
+            # Same seeds as the thread path's actor-init resets, so pooled
+            # and thread trajectories stay bit-identical from any reset.
+            for i, env in enumerate(envs):
+                obs, _ = env.reset(seed=base_seed + i)
+                obs_block[i] = np.asarray(obs)
+            ep_return[:] = 0.0
+            ep_len[:] = 0
+
+        reset_envs()
         conn.send(("ready", task_ids))
 
         while True:
             msg = conn.recv()
             if msg[0] == "close":
                 return
+            if msg[0] == "reset":
+                # True episode restarts (not just a shm re-read): used when
+                # a respawned inference actor re-attaches so its first=True
+                # flags describe real episode boundaries, not mid-episode
+                # states.
+                reset_envs()
+                conn.send(("reset_done",))
+                continue
             assert msg[0] == "step", msg
             actions = msg[1]
             rewards = np.empty((num_envs,), np.float32)
@@ -273,8 +284,29 @@ class ProcessEnvPool:
         return self._num_workers * self._envs_per_worker
 
     def reset_all(self) -> np.ndarray:
-        """Initial observations. Workers reset at spawn, so this just reads
-        the shared block (also the recovery point after a restart)."""
+        """Reset EVERY env (workers re-seed exactly as at spawn) and return
+        the initial observations. A respawned inference actor calls this on
+        re-attach, so its fresh first=True flags and recurrent state line up
+        with true episode starts — a bare shm read would hand it mid-episode
+        observations labeled as episode boundaries."""
+        dead: List[int] = []
+        for w in range(self._num_workers):
+            try:
+                self._conns[w].send(("reset",))
+            except (BrokenPipeError, OSError) as e:
+                self._restart(w, f"send failed: {e!r}")
+                dead.append(w)  # fresh worker already wrote reset obs
+        for w in range(self._num_workers):
+            if w in dead:
+                continue
+            try:
+                msg = self._recv(w)
+                if msg[0] != "reset_done":
+                    raise RuntimeError(
+                        f"env worker {w}: unexpected reply {msg!r}"
+                    )
+            except (EOFError, TimeoutError, RuntimeError) as e:
+                self._restart(w, repr(e))
         return np.array(self._obs_block)  # copy out of the shared buffer
 
     def step_all(self, actions: np.ndarray):
@@ -291,11 +323,23 @@ class ProcessEnvPool:
         dones = np.zeros((n,), np.bool_)
         events: List[Tuple[int, float, int]] = []
         actions = np.asarray(actions, np.int32)
+        # Workers whose command could not even be SENT (abrupt process
+        # death between rounds — SIGKILL/OOM) repair through the same path
+        # as recv-side failures instead of crashing the inference actor.
+        dead: List[int] = []
         for w in range(self._num_workers):
             sl = self._worker_slice(w)
-            self._conns[w].send(("step", actions[sl].tolist()))
+            try:
+                self._conns[w].send(("step", actions[sl].tolist()))
+            except (BrokenPipeError, OSError) as e:
+                self._restart(w, f"send failed: {e!r}")
+                dead.append(w)
         for w in range(self._num_workers):
             sl = self._worker_slice(w)
+            if w in dead:
+                # Fresh worker wrote reset obs; mark an episode boundary.
+                dones[sl] = True
+                continue
             try:
                 msg = self._recv(w)
                 if msg[0] == "error":
@@ -309,7 +353,6 @@ class ProcessEnvPool:
                 )
             except (EOFError, TimeoutError, RuntimeError) as e:
                 self._restart(w, repr(e))
-                # Fresh worker wrote reset obs; mark an episode boundary.
                 dones[sl] = True
         return np.array(self._obs_block), rewards, dones, events
 

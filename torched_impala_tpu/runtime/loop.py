@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import inspect
 import sys
 import threading
 from typing import Any, Callable, Mapping, Optional
@@ -159,17 +158,17 @@ def train(
     # multi-task families can cover every task — task selection must NOT be
     # derived from the seed (seeds stride by 1000 per actor, and
     # gcd(1000, num_tasks) > 1 silently drops tasks).
-    try:
-        _factory_takes_index = (
-            len(inspect.signature(env_factory).parameters) >= 2
-        )
-    except (TypeError, ValueError):
-        _factory_takes_index = False
+    from torched_impala_tpu.envs.factory import call_env_factory
 
     def build_env(seed_: int, env_index: int):
-        if _factory_takes_index:
-            return env_factory(seed_, env_index)
-        return env_factory(seed_)
+        return call_env_factory(env_factory, seed_, env_index)
+
+    # Multi-host: every controller runs this same function with the same
+    # --seed, so actor slots must be offset by the process index or all
+    # hosts step IDENTICAL env streams and the global batch holds n copies
+    # of the same data (effective batch / n, corrupted gradients).
+    # jax.process_index() is 0 when jax.distributed was never initialized.
+    host_slot0 = jax.process_index() * num_actors
 
     env_pools: list = []
     if actor_mode == "process":
@@ -188,28 +187,36 @@ def train(
                 list(range(num_actors // 2, num_actors)),
             ]
         )
-        for gi, group in enumerate(groups):
-            env_pools.append(
-                ProcessEnvPool(
-                    env_factory=env_factory,
-                    num_workers=len(group),
-                    envs_per_worker=envs_per_actor,
-                    obs_shape=example_obs.shape,
-                    obs_dtype=example_obs.dtype,
-                    base_seed=seed + 1000 * group[0],
-                    first_env_index=group[0] * envs_per_actor,
-                    max_restarts=(
-                        max_actor_restarts * len(group)
-                        if max_actor_restarts is not None
-                        else 1_000_000
-                    ),
+        try:
+            for gi, group in enumerate(groups):
+                env_pools.append(
+                    ProcessEnvPool(
+                        env_factory=env_factory,
+                        num_workers=len(group),
+                        envs_per_worker=envs_per_actor,
+                        obs_shape=example_obs.shape,
+                        obs_dtype=example_obs.dtype,
+                        base_seed=seed + 1000 * (host_slot0 + group[0]),
+                        first_env_index=(host_slot0 + group[0])
+                        * envs_per_actor,
+                        max_restarts=(
+                            max_actor_restarts * len(group)
+                            if max_actor_restarts is not None
+                            else 1_000_000
+                        ),
+                    )
                 )
-            )
+        except BaseException:
+            # A failed later pool must not leak the earlier pools' worker
+            # processes and SharedMemory segments.
+            for pool in env_pools:
+                pool.close()
+            raise
 
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the
         # published params, so restart-after-crash just rebuilds the envs.
-        base_seed = seed + 1000 * (slot + 1)
+        base_seed = seed + 1000 * (host_slot0 + slot + 1)
         common = dict(
             actor_id=slot,
             agent=agent,
@@ -228,12 +235,17 @@ def train(
         if envs_per_actor > 1:
             return VectorActor(
                 envs=[
-                    build_env(base_seed + j, slot * envs_per_actor + j)
+                    build_env(
+                        base_seed + j,
+                        (host_slot0 + slot) * envs_per_actor + j,
+                    )
                     for j in range(envs_per_actor)
                 ],
                 **common,
             )
-        return Actor(env=build_env(base_seed, slot), **common)
+        return Actor(
+            env=build_env(base_seed, host_slot0 + slot), **common
+        )
 
     def on_restart(slot: int, error: BaseException) -> None:
         # stderr, not the metrics logger: this runs on the monitor thread.

@@ -112,7 +112,22 @@ class Checkpointer:
         abstract = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, self._normalize(target)
         )
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except BaseException:
+            # Back-compat: checkpoints written before the 'rng' entry was
+            # added lack that key, and StandardRestore requires structural
+            # match — retry without it (set_state treats rng as optional).
+            if isinstance(abstract, dict) and "rng" in abstract:
+                reduced = {
+                    k: v for k, v in abstract.items() if k != "rng"
+                }
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(reduced)
+                )
+            raise
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
