@@ -302,3 +302,39 @@ def test_vtrace_auto_resolves_to_devices_not_default_backend():
         )
         # Test env forces the CPU platform, so 'auto' must become 'scan'.
         assert learner._config.loss.vtrace_implementation == "scan"
+
+
+def test_multihost_actor_seeds_offset_by_process_index(monkeypatch):
+    """Every controller runs train() with the same --seed; actor seeds and
+    env indices must fold in jax.process_index() or all hosts produce
+    identical trajectories (review finding: global batch held n copies)."""
+    import optax
+
+    from torched_impala_tpu.runtime.loop import train
+
+    seen = {}
+
+    def recording_factory(seed, env_index=None):
+        seen[seed] = env_index
+        return ScriptedEnv(episode_len=3)
+
+    def run_as_host(idx):
+        seen.clear()
+        monkeypatch.setattr(jax, "process_index", lambda: idx)
+        train(
+            agent=_agent(),
+            env_factory=recording_factory,
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            envs_per_actor=2,
+            learner_config=LearnerConfig(batch_size=2, unroll_length=3),
+            optimizer=optax.sgd(1e-3),
+            total_steps=1,
+            seed=7,
+        )
+        return dict(seen)
+
+    host0, host1 = run_as_host(0), run_as_host(1)
+    # Disjoint seed sets and disjoint global env indices across hosts.
+    assert not (set(host0) & set(host1)), (host0, host1)
+    assert not (set(host0.values()) & set(host1.values())), (host0, host1)
