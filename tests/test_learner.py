@@ -168,6 +168,9 @@ def test_watchdog_raises_when_all_actors_die():
             optimizer=optax.sgd(1e-2),
             total_steps=5,
             seed=0,
+            # 1 restart proves the recover-then-give-up path; the default
+            # 10-restart budget spends ~2min in exponential backoff.
+            max_actor_restarts=1,
         )
 
 

@@ -18,6 +18,11 @@ estimator). The full off-policy machinery still runs — same
 `impala_loss`, same nets — so switching a config between host actors and
 Anakin changes throughput, not semantics.
 
+Deliberate non-goal: PopArt / multi-task stays actor-runtime-only. The
+only multi-task preset is DMLab-30, whose C++ emulator can never be a
+pure-JAX env; threading per-slot task ids through the fused program
+would exercise a loss path no on-device env family can feed.
+
 Data parallelism: with a mesh, params/opt state are replicated and the
 env batch is sharded over the `data` axis; per-env RNG is derived by
 `fold_in(key, global env index)` so resharding never changes the random
