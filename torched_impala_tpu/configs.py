@@ -21,7 +21,7 @@ smoke config (run_catch.py:29-36,59).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
+from typing import Callable
 
 import jax.numpy as jnp
 import numpy as np
