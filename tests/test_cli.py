@@ -299,3 +299,23 @@ class TestAnakinCLI:
         from torched_impala_tpu.utils.checkpoint import Checkpointer
 
         assert Checkpointer(ck).latest_step() == 5
+
+    def test_pixels_preset_trains_and_evals(self, tmp_path):
+        rc = cli_main([
+            "--config", "pixels_anakin",
+            "--total-steps", "3",
+            "--batch-size", "4",
+            "--unroll-length", "5",
+            "--log-every", "1",
+            "--logger", "jsonl",
+            "--logdir", str(tmp_path),
+        ])
+        assert rc == 0
+        lines = (tmp_path / "pixels_anakin.jsonl").read_text().splitlines()
+        assert np.isfinite(json.loads(lines[-1])["total_loss"])
+        rc = cli_main([
+            "--config", "pixels_anakin",
+            "--mode", "eval",
+            "--eval-episodes", "2",
+        ])
+        assert rc == 0

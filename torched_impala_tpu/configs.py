@@ -272,15 +272,21 @@ class _EnvFactory:
 
 def make_jax_env(cfg: ExperimentConfig):
     """Build the pure-JAX env for `runtime="anakin"` presets."""
-    from torched_impala_tpu.envs import JaxCartPole, JaxCatch
+    from torched_impala_tpu.envs import JaxCartPole, JaxCatch, JaxPixelSignal
 
     if cfg.env_family == "jax_cartpole":
         return JaxCartPole()
     if cfg.env_family == "jax_catch":
         return JaxCatch()
+    if cfg.env_family == "jax_pixels":
+        return JaxPixelSignal(
+            size=cfg.obs_shape[0],
+            channels=cfg.obs_shape[-1],
+            num_actions=cfg.num_actions,
+        )
     raise ValueError(
         f"env_family {cfg.env_family!r} has no pure-JAX implementation "
-        "(anakin runtime needs one of: jax_cartpole, jax_catch)"
+        "(anakin runtime needs one of: jax_cartpole, jax_catch, jax_pixels)"
     )
 
 
@@ -444,6 +450,26 @@ CATCH_ANAKIN = ExperimentConfig(
     lr_anneal=False,
 )
 
+# Atari-shaped pixels fully on-device: the bf16 Nature-CNN learns the
+# JaxPixelSignal quadrant->action signal with env stepping fused into the
+# train program — the closest on-device analog of the Pong pipeline.
+PIXELS_ANAKIN = ExperimentConfig(
+    name="pixels_anakin",
+    env_family="jax_pixels",
+    obs_shape=(84, 84, 4),
+    obs_dtype="uint8",
+    num_actions=4,
+    model="shallow_cnn",
+    compute_dtype="bfloat16",
+    runtime="anakin",
+    loss_reduction="mean",
+    unroll_length=20,
+    batch_size=128,
+    total_env_frames=50_000_000,
+    lr=1e-3,
+    lr_anneal=False,
+)
+
 REGISTRY: dict[str, ExperimentConfig] = {
     c.name: c
     for c in (
@@ -455,5 +481,6 @@ REGISTRY: dict[str, ExperimentConfig] = {
         PONG_TRANSFORMER,
         CARTPOLE_ANAKIN,
         CATCH_ANAKIN,
+        PIXELS_ANAKIN,
     )
 }
