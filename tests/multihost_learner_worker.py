@@ -96,6 +96,54 @@ def main() -> None:
         assert leaf.sharding.is_fully_replicated
     print(f"RESULT process={process_id} loss={loss:.10f}", flush=True)
 
+    # Phase 2: the FULL train() loop across both controllers — each host
+    # runs its own actor fleet (seeds offset by jax.process_index(), so the
+    # hosts contribute DISTINCT trajectories to the global batch), its own
+    # batcher, and the shared SPMD learner program. Both controllers must
+    # report the same global loss.
+    from torched_impala_tpu.envs import FakeDiscreteEnv
+    from torched_impala_tpu.runtime.loop import train
+
+    def env_factory(seed, env_index=None):
+        return FakeDiscreteEnv(obs_shape=(4,), num_actions=3, seed=seed)
+
+    seen_seeds = []
+
+    def recording_factory(seed, env_index=None):
+        seen_seeds.append(seed)
+        return env_factory(seed, env_index)
+
+    step_losses = []
+
+    def logger(logs):
+        step_losses.append(float(logs["total_loss"]))
+
+    result = train(
+        agent=Agent(ImpalaNet(num_actions=3, torso=MLPTorso())),
+        env_factory=recording_factory,
+        example_obs=np.zeros((4,), np.float32),
+        num_actors=2,
+        learner_config=LearnerConfig(batch_size=B_global, unroll_length=T),
+        optimizer=optax.sgd(1e-2),
+        total_steps=3,
+        seed=0,
+        logger=logger,
+        log_every=1,
+        mesh=mesh,
+    )
+    assert result.learner.num_steps == 3
+    # Host-distinct actor seeds (the multi-host duplicate-data fix).
+    expected_base = 1000 * (2 * process_id + 1)
+    assert all(s >= expected_base for s in seen_seeds), (
+        process_id,
+        seen_seeds,
+    )
+    print(
+        f"RESULT2 process={process_id} loss={step_losses[-1]:.10f} "
+        f"seeds={sorted(set(seen_seeds))}",
+        flush=True,
+    )
+
 
 if __name__ == "__main__":
     main()

@@ -50,11 +50,25 @@ def test_two_process_global_mesh_learner_step():
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
 
-    losses = []
+    losses, loop_losses, seed_sets = [], [], []
     for out in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT")]
+        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
         assert len(lines) == 1, out
         losses.append(float(lines[0].split("loss=")[1]))
+        lines2 = [
+            ln for ln in out.splitlines() if ln.startswith("RESULT2 ")
+        ]
+        assert len(lines2) == 1, out
+        loop_losses.append(
+            float(lines2[0].split("loss=")[1].split(" ")[0])
+        )
+        seed_sets.append(lines2[0].split("seeds=")[1])
     # One global batch, one SPMD program: both controllers see THE loss.
     assert np.isfinite(losses[0])
     assert losses[0] == losses[1]
+    # Full train() loop: same global program, same loss on both
+    # controllers — but DISTINCT host-local actor seed sets (the
+    # duplicate-data fix).
+    assert np.isfinite(loop_losses[0])
+    assert loop_losses[0] == loop_losses[1]
+    assert seed_sets[0] != seed_sets[1]

@@ -87,7 +87,11 @@ def train(
     device = None
     if actor_device is not None:
         try:
-            device = jax.devices(actor_device)[0]
+            # LOCAL devices: under multi-controller (jax.distributed),
+            # jax.devices()[0] is GLOBAL device 0 — non-addressable from
+            # every other process, so actor inference there dies with
+            # "spans non-addressable devices".
+            device = jax.local_devices(backend=actor_device)[0]
         except RuntimeError:
             device = None  # platform not enabled; use default backend
 
