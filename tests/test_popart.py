@@ -281,5 +281,80 @@ class TestLearnerIntegration:
         )
 
 
+def test_popart_fused_dispatch_matches_sequential():
+    """PopArt state threads through the fused lax.scan: one K=2 dispatch
+    equals two sequential steps (params, mu/nu, and rescaled value head)."""
+    import optax
+
+    from torched_impala_tpu.envs.fake import FakeDiscreteEnv
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.runtime import Actor, Learner, LearnerConfig
+
+    num_tasks, T, K = 2, 4, 2
+    results = {}
+    for k in (1, K):
+        agent = Agent(
+            ImpalaNet(
+                num_actions=3,
+                torso=MLPTorso(hidden_sizes=(16,)),
+                num_values=num_tasks,
+            )
+        )
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=num_tasks,
+                unroll_length=T,
+                steps_per_dispatch=k,
+                queue_capacity=K * num_tasks,
+                popart=PopArtConfig(num_values=num_tasks, step_size=0.1),
+            ),
+            example_obs=np.zeros((8,), np.float32),
+            rng=jax.random.key(0),
+        )
+        actors = [
+            Actor(
+                actor_id=i,
+                env=FakeDiscreteEnv(
+                    obs_shape=(8,), num_actions=3, episode_len=7,
+                    reward_scale=5.0 ** i, seed=i,
+                ),
+                agent=agent,
+                param_store=learner.param_store,
+                enqueue=learner.enqueue,
+                unroll_length=T,
+                seed=i,
+                task=i,
+            )
+            for i in range(num_tasks)
+        ]
+        for _ in range(K):
+            for a in actors:
+                a.unroll_and_push()
+        learner.start()
+        try:
+            for _ in range(K // k):
+                learner.step_once(timeout=300)
+        finally:
+            learner.stop()
+        results[k] = (
+            jax.tree.map(np.asarray, learner.params),
+            np.asarray(learner.popart_state.mu),
+            np.asarray(learner.popart_state.nu),
+        )
+
+    p1, mu1, nu1 = results[1]
+    pk, muk, nuk = results[K]
+    np.testing.assert_allclose(mu1, muk, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(nu1, nuk, rtol=1e-5, atol=1e-7)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        p1,
+        pk,
+    )
+
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -88,6 +88,10 @@ class ExperimentConfig:
     actor_mode: str = "thread"
     unroll_length: int = 20
     batch_size: int = 8
+    # Fuse K SGD steps into one dispatched XLA program (lax.scan over a
+    # [K, ...] superbatch) — amortizes per-dispatch host latency at the
+    # cost of params publish landing every K steps (LearnerConfig docs).
+    steps_per_dispatch: int = 1
     total_env_frames: int = 1_000_000
     # Optimization.
     lr: float = 6e-4
@@ -172,6 +176,7 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
             reduction=cfg.loss_reduction,
         ),
         max_grad_norm=cfg.max_grad_norm,
+        steps_per_dispatch=cfg.steps_per_dispatch,
         popart=(
             PopArtConfig(
                 num_values=cfg.num_tasks, step_size=cfg.popart_step_size

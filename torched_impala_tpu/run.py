@@ -43,6 +43,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(GIL escape) feeding one batched-inference actor")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--unroll-length", type=int, default=None)
+    p.add_argument("--steps-per-dispatch", type=int, default=None,
+                   help="fuse K SGD steps into one dispatched XLA program "
+                        "(amortizes host dispatch latency; params publish "
+                        "every K steps — see LearnerConfig)")
     p.add_argument("--total-steps", type=int, default=None,
                    help="learner updates (default: total_env_frames/T*B)")
     p.add_argument("--total-env-frames", type=int, default=None)
@@ -104,6 +108,7 @@ def build_config(args: argparse.Namespace):
         ("actor_mode", "actor_mode"),
         ("batch_size", "batch_size"),
         ("unroll_length", "unroll_length"),
+        ("steps_per_dispatch", "steps_per_dispatch"),
         ("total_env_frames", "total_env_frames"),
         ("lr", "lr"),
         ("dp", "dp_devices"),
@@ -278,6 +283,7 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
             num_envs=cfg.batch_size,
             unroll_length=cfg.unroll_length,
             loss=configs.make_learner_config(cfg).loss,
+            updates_per_dispatch=cfg.steps_per_dispatch,
         ),
         rng=jax.random.key(args.seed),
         mesh=mesh,
@@ -292,8 +298,18 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
                 file=sys.stderr,
             )
     # Budget semantics match the actor runtime: total_steps is the TOTAL
-    # budget; a resumed run performs only the remainder.
-    remaining = max(0, total_steps - runner.num_steps)
+    # budget; a resumed run performs only the remainder. With fused
+    # dispatch (steps_per_dispatch > 1) the loop never overshoots: it runs
+    # the largest multiple of N that fits (Learner.run semantics).
+    N = cfg.steps_per_dispatch
+    remaining_updates = max(0, total_steps - runner.num_steps)
+    if remaining_updates % N:
+        print(
+            f"warning: step budget remainder {remaining_updates % N} < "
+            f"steps_per_dispatch={N} will not run",
+            file=sys.stderr,
+        )
+    remaining = remaining_updates // N
 
     profile_ctx = None
     if args.profile_dir:
@@ -302,11 +318,17 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
         )
         profile_ctx.__enter__()
     logs = {}
+    start_frames = runner.num_frames
     t0 = time.perf_counter()
     try:
+        from torched_impala_tpu.runtime import crossed_interval
+
+        def crossed(interval: int) -> bool:
+            return crossed_interval(runner.num_steps, N, interval)
+
         for _ in range(remaining):
             logs = runner.step()
-            if args.log_every and runner.num_steps % args.log_every == 0:
+            if args.log_every and crossed(args.log_every):
                 host_logs = {k: float(v) for k, v in logs.items()}
                 host_logs["num_steps"] = runner.num_steps
                 host_logs["num_frames"] = runner.num_frames
@@ -314,7 +336,7 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
             if (
                 checkpointer is not None
                 and args.checkpoint_interval
-                and runner.num_steps % args.checkpoint_interval == 0
+                and crossed(args.checkpoint_interval)
             ):
                 checkpointer.save(runner.num_steps, runner.get_state())
     finally:
@@ -327,7 +349,7 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
         logger.close()
     jax.block_until_ready(jax.tree.leaves(runner.params)[0])
     dt = time.perf_counter() - t0
-    fps = remaining * runner.frames_per_step / dt if dt > 0 else 0.0
+    fps = (runner.num_frames - start_frames) / dt if dt > 0 else 0.0
     ret = float(logs.get("episode_return_mean", float("nan")))
     print(
         f"done: steps={runner.num_steps} frames={runner.num_frames} "

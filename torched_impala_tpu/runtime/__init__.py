@@ -15,6 +15,7 @@ from torched_impala_tpu.runtime.evaluator import (  # noqa: F401
 from torched_impala_tpu.runtime.learner import (  # noqa: F401
     Learner,
     LearnerConfig,
+    stack_superbatch,
     stack_trajectories,
 )
 from torched_impala_tpu.runtime.loop import TrainResult, train  # noqa: F401
@@ -25,6 +26,7 @@ from torched_impala_tpu.runtime.supervisor import (  # noqa: F401
 from torched_impala_tpu.runtime.types import (  # noqa: F401
     QueueClosed,
     Trajectory,
+    crossed_interval,
 )
 from torched_impala_tpu.runtime.vector_actor import VectorActor  # noqa: F401
 
@@ -40,9 +42,11 @@ __all__ = [
     "ParamStore",
     "ProcessEnvPool",
     "QueueClosed",
+    "crossed_interval",
     "TrainResult",
     "Trajectory",
     "VectorActor",
+    "stack_superbatch",
     "stack_trajectories",
     "train",
 ]

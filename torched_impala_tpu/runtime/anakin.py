@@ -54,6 +54,12 @@ class AnakinConfig:
     num_envs: int  # E: global env batch (divisible by the data axis)
     unroll_length: int  # T: steps per iteration
     loss: ImpalaLossConfig = ImpalaLossConfig()
+    # Fuse N rollout+update iterations into ONE dispatched XLA program
+    # (`lax.scan` over the whole iteration). Anakin needs no extra data to
+    # do this — env state is part of the carry — so the only cost is log
+    # scalars landing every N updates. Amortizes the fixed per-dispatch
+    # host latency exactly like LearnerConfig.steps_per_dispatch.
+    updates_per_dispatch: int = 1
 
 
 class AnakinRunner:
@@ -115,9 +121,19 @@ class AnakinRunner:
         self.num_steps = 0
         self.num_frames = 0
 
+        if config.updates_per_dispatch < 1:
+            raise ValueError(
+                f"updates_per_dispatch must be >= 1, got "
+                f"{config.updates_per_dispatch}"
+            )
+        step_impl = (
+            self._multi_step_impl
+            if config.updates_per_dispatch > 1
+            else self._step_impl
+        )
         if mesh is None:
             self._step_fn = jax.jit(
-                self._step_impl, donate_argnums=(0, 1, 2)
+                step_impl, donate_argnums=(0, 1, 2)
             )
         else:
             rep = replicated(mesh)
@@ -138,7 +154,7 @@ class AnakinRunner:
                 is_leaf=lambda x: isinstance(x, jax.Array),
             )
             self._step_fn = jax.jit(
-                self._step_impl,
+                step_impl,
                 donate_argnums=(0, 1, 2),
                 in_shardings=(rep, rep, carry_shardings),
                 out_shardings=(rep, rep, carry_shardings, rep),
@@ -271,15 +287,49 @@ class AnakinRunner:
         )
         return params, opt_state, carry, logs
 
+    def _multi_step_impl(self, params, opt_state, carry):
+        """N chained iterations in one XLA program (updates_per_dispatch).
+
+        Scalar logs are the LAST iteration's, except the episode stats,
+        which aggregate over all N windows (a per-window mean would throw
+        away N-1 windows' completed episodes)."""
+        N = self._config.updates_per_dispatch
+
+        def body(c, _):
+            p, o, cr = c
+            p, o, cr, logs = self._step_impl(p, o, cr)
+            return (p, o, cr), logs
+
+        (params, opt_state, carry), logs_seq = jax.lax.scan(
+            body, (params, opt_state, carry), None, length=N
+        )
+        logs = {k: v[-1] for k, v in logs_seq.items()}
+        finished = jnp.sum(logs_seq["episodes_finished"])
+        per_window_sums = jnp.where(
+            logs_seq["episodes_finished"] > 0,
+            logs_seq["episode_return_mean"]
+            * logs_seq["episodes_finished"],
+            0.0,
+        )
+        logs["episodes_finished"] = finished
+        logs["episode_return_mean"] = jnp.where(
+            finished > 0,
+            jnp.sum(per_window_sums) / jnp.maximum(finished, 1.0),
+            jnp.nan,
+        )
+        return params, opt_state, carry, logs
+
     # ---- host-side driver ---------------------------------------------
 
     def step(self) -> Mapping[str, Any]:
-        """One iteration: T steps of E envs + one SGD update, all on device."""
+        """One dispatch: `updates_per_dispatch` iterations of (T steps of E
+        envs + one SGD update), all on device."""
         self.params, self.opt_state, self._carry, logs = self._step_fn(
             self.params, self.opt_state, self._carry
         )
-        self.num_steps += 1
-        self.num_frames += self.frames_per_step
+        N = self._config.updates_per_dispatch
+        self.num_steps += N
+        self.num_frames += self.frames_per_step * N
         return logs
 
     def run(
@@ -289,12 +339,24 @@ class AnakinRunner:
         log_every: int = 0,
         logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
     ) -> Mapping[str, Any]:
-        """Run iterations; returns the final logs dict with throughput."""
+        """Run `num_iterations` dispatches (each = updates_per_dispatch
+        updates); returns the final logs dict with throughput.
+
+        `log_every` counts UPDATES (num_steps), matching the CLI's
+        --log-every semantics regardless of updates_per_dispatch."""
+        from torched_impala_tpu.runtime.types import crossed_interval
+
         logs: Mapping[str, Any] = {}
+        N = self._config.updates_per_dispatch
+        start_frames = self.num_frames
         t0 = time.perf_counter()
         for i in range(num_iterations):
             logs = self.step()
-            if logger is not None and log_every and (i + 1) % log_every == 0:
+            if (
+                logger is not None
+                and log_every
+                and crossed_interval(self.num_steps, N, log_every)
+            ):
                 host_logs = {k: float(v) for k, v in logs.items()}
                 host_logs["num_steps"] = self.num_steps
                 host_logs["num_frames"] = self.num_frames
@@ -305,6 +367,6 @@ class AnakinRunner:
         out["num_steps"] = self.num_steps
         out["num_frames"] = self.num_frames
         out["frames_per_sec"] = (
-            num_iterations * self.frames_per_step / dt if dt > 0 else 0.0
+            (self.num_frames - start_frames) / dt if dt > 0 else 0.0
         )
         return out

@@ -43,7 +43,11 @@ from torched_impala_tpu.parallel.mesh import (
 )
 from torched_impala_tpu.parallel import multihost
 from torched_impala_tpu.runtime.param_store import ParamStore
-from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
+from torched_impala_tpu.runtime.types import (
+    QueueClosed,
+    Trajectory,
+    crossed_interval,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +68,14 @@ class LearnerConfig:
     # PopArt value normalization (multi-task DMLab-30 config); None = off.
     # When set, the agent's net must have num_values == popart.num_values.
     popart: Optional[PopArtConfig] = None
+    # Fuse K SGD steps into ONE dispatched XLA program (`lax.scan` over a
+    # [K, ...] superbatch). Each host→device dispatch carries fixed latency
+    # (RPC + argument handling — ~24% of step wall time on a tunnelled
+    # chip, NOTES_r02.md trace analysis); fusing K steps amortizes it K-fold.
+    # Costs: params publish / telemetry land every K steps instead of every
+    # step (actor staleness grows by up to K-1 extra updates — V-trace is
+    # built for exactly this), and K batches are resident on device at once.
+    steps_per_dispatch: int = 1
     # Assemble batches with the native (C++) batcher (native/batcher.cpp).
     # Measured on this image (32x Atari unrolls): numpy np.stack already
     # releases the GIL in its copy loops and is ~18% faster single-thread,
@@ -74,9 +86,36 @@ class LearnerConfig:
     native_batcher: bool = False
 
 
-def stack_trajectories(trajs: list[Trajectory]) -> Trajectory:
+def stack_trajectories(
+    trajs: list[Trajectory], out: Optional[Trajectory] = None
+) -> Trajectory:
     """Stack B unrolls into one time-major batch: leaves `[T(+1), B, ...]`;
-    agent_state leaves concatenate on their existing batch axis."""
+    agent_state leaves concatenate on their existing batch axis.
+
+    `out` (a Trajectory of preallocated, correctly-shaped array views)
+    stacks in place — the fused-dispatch batcher passes slices of its
+    `[K, ...]` superbatch so each unroll is copied exactly once."""
+    if out is not None:
+        np.stack([t.obs for t in trajs], axis=1, out=out.obs)
+        np.stack([t.first for t in trajs], axis=1, out=out.first)
+        np.stack([t.actions for t in trajs], axis=1, out=out.actions)
+        np.stack(
+            [t.behaviour_logits for t in trajs],
+            axis=1,
+            out=out.behaviour_logits,
+        )
+        np.stack([t.rewards for t in trajs], axis=1, out=out.rewards)
+        np.stack([t.cont for t in trajs], axis=1, out=out.cont)
+        if trajs[0].agent_state != ():
+            jax.tree.map(
+                lambda o, *xs: np.concatenate(xs, axis=0, out=o),
+                out.agent_state,
+                *[t.agent_state for t in trajs],
+            )
+        out.task[...] = [t.task for t in trajs]
+        return out._replace(
+            param_version=min(t.param_version for t in trajs)
+        )
     batched = Trajectory(
         obs=np.stack([t.obs for t in trajs], axis=1),
         first=np.stack([t.first for t in trajs], axis=1),
@@ -97,6 +136,33 @@ def stack_trajectories(trajs: list[Trajectory]) -> Trajectory:
         task=np.asarray([t.task for t in trajs], np.int32),
     )
     return batched
+
+
+def stack_superbatch(batches: list[Trajectory]) -> Trajectory:
+    """Stack K already-batched trajectories along a new leading axis:
+    array leaves `[K, T(+1), B, ...]`, task `[K, B]`, agent_state leaves
+    `[K, B, ...]` — the xs of the fused `lax.scan` over K SGD steps.
+
+    Reference implementation (copies each batch a second time); the
+    batcher's hot path assembles unrolls directly into the superbatch via
+    `stack_trajectories(..., out=slice)` instead. Kept public as the
+    oracle the in-place path is tested against."""
+    return Trajectory(
+        obs=np.stack([b.obs for b in batches]),
+        first=np.stack([b.first for b in batches]),
+        actions=np.stack([b.actions for b in batches]),
+        behaviour_logits=np.stack([b.behaviour_logits for b in batches]),
+        rewards=np.stack([b.rewards for b in batches]),
+        cont=np.stack([b.cont for b in batches]),
+        agent_state=jax.tree.map(
+            lambda *xs: np.stack(xs), *[b.agent_state for b in batches]
+        )
+        if batches[0].agent_state != ()
+        else (),
+        actor_id=-1,
+        param_version=min(b.param_version for b in batches),
+        task=np.stack([b.task for b in batches]),
+    )
 
 
 class Learner:
@@ -207,20 +273,36 @@ class Learner:
         self.param_store = ParamStore()
         self._publish()
 
-        if mesh is None:
-            self._train_step = jax.jit(
-                self._train_step_impl, donate_argnums=(0, 1, 2)
+        if config.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{config.steps_per_dispatch}"
             )
+        fused = config.steps_per_dispatch > 1
+        step_impl = self._train_multi_impl if fused else self._train_step_impl
+        if mesh is None:
+            self._train_step = jax.jit(step_impl, donate_argnums=(0, 1, 2))
         else:
             rep = replicated(mesh)
             bs = batch_sharding(mesh)
             ss = state_sharding(mesh)
+            if fused:
+                # Superbatch leaves carry a leading K axis the scan consumes;
+                # it stays unsharded (steps are sequential by construction).
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                def _k(sh):
+                    return NamedSharding(
+                        mesh, PartitionSpec(None, *tuple(sh.spec))
+                    )
+
+                bs, ss = _k(bs), _k(ss)
             # Prefix pytrees: one sharding covers each whole subtree.
             # (obs, first, actions, logits, rewards, cont all [T(+1), B, ...];
             # tasks and agent_state leaves are [B, ...].)
             self._batch_shardings = (bs, bs, bs, bs, bs, bs, ss, ss)
             self._train_step = jax.jit(
-                self._train_step_impl,
+                step_impl,
                 donate_argnums=(0, 1, 2),
                 in_shardings=(rep, rep, rep) + self._batch_shardings,
                 out_shardings=(rep, rep, rep, rep),
@@ -303,6 +385,27 @@ class Learner:
         logs["weight_norm"] = optax.global_norm(params)
         return params, opt_state, new_popart, logs
 
+    def _train_multi_impl(
+        self, params, opt_state, popart_state, *stacked
+    ):
+        """K chained SGD steps in one XLA program (steps_per_dispatch > 1).
+
+        `stacked` mirrors `_train_step_impl`'s batch arguments with a
+        leading K axis; `lax.scan` slices one batch per step and threads
+        (params, opt_state, popart_state) through. Returned logs are the
+        LAST step's (the state actors will see), so log semantics match
+        the unfused path."""
+
+        def body(carry, xs):
+            p, o, pa, logs = self._train_step_impl(*carry, *xs)
+            return (p, o, pa), logs
+
+        (params, opt_state, popart_state), logs_seq = jax.lax.scan(
+            body, (params, opt_state, popart_state), stacked
+        )
+        logs = jax.tree.map(lambda x: x[-1], logs_seq)
+        return params, opt_state, popart_state, logs
+
     # ---- data plumbing -------------------------------------------------
 
     def enqueue(self, traj: Trajectory) -> None:
@@ -324,33 +427,107 @@ class Learner:
             self.error = e
             raise
 
-    def _batcher_loop_impl(self) -> None:
+    def _collect_trajs(self) -> Optional[list[Trajectory]]:
+        """Block for B unrolls from the host queue; None on stop."""
         B = self._local_batch_size
-        while not self._stop.is_set():
-            trajs: list[Trajectory] = []
-            while len(trajs) < B:
-                if self._stop.is_set():
-                    return
-                try:
-                    trajs.append(self._traj_q.get(timeout=0.5))
-                except queue.Empty:
-                    continue
-            batch = None
-            if self._config.native_batcher:
-                from torched_impala_tpu.native.stack import (
-                    fast_stack_trajectories,
-                )
+        trajs: list[Trajectory] = []
+        while len(trajs) < B:
+            if self._stop.is_set():
+                return None
+            try:
+                trajs.append(self._traj_q.get(timeout=0.5))
+            except queue.Empty:
+                continue
+        return trajs
 
-                batch = fast_stack_trajectories(trajs)
+    def _assemble_batch(self) -> Optional[Trajectory]:
+        trajs = self._collect_trajs()
+        if trajs is None:
+            return None
+        if self._config.native_batcher:
+            from torched_impala_tpu.native.stack import (
+                fast_stack_trajectories,
+            )
+
+            batch = fast_stack_trajectories(trajs)
+            if batch is not None:
+                return batch
+        return stack_trajectories(trajs)
+
+    def _assemble_superbatch(self, K: int) -> Optional[Trajectory]:
+        """`[K, ...]` superbatch, each slice stacked in place so every
+        unroll is copied once (not batch-then-restack). Allocation shapes
+        come from the first round's trajectories. Bypasses the native
+        batcher (which can't target views); numpy measured faster on this
+        host anyway (LearnerConfig.native_batcher)."""
+        sb: Optional[Trajectory] = None
+        versions = []
+        for k in range(K):
+            trajs = self._collect_trajs()
+            if trajs is None:
+                return None
+            if sb is None:
+                t0, B = trajs[0], len(trajs)
+
+                def _alloc_stacked(x):
+                    # [T(+1), ...] per unroll -> [K, T(+1), B, ...]
+                    return np.empty(
+                        (K, x.shape[0], B) + x.shape[1:], x.dtype
+                    )
+
+                def _alloc_state(x):
+                    # [b, ...] per unroll, concatenated over axis 0.
+                    return np.empty(
+                        (K, B * x.shape[0]) + x.shape[1:], x.dtype
+                    )
+
+                sb = Trajectory(
+                    obs=_alloc_stacked(t0.obs),
+                    first=_alloc_stacked(t0.first),
+                    actions=_alloc_stacked(t0.actions),
+                    behaviour_logits=_alloc_stacked(t0.behaviour_logits),
+                    rewards=_alloc_stacked(t0.rewards),
+                    cont=_alloc_stacked(t0.cont),
+                    agent_state=jax.tree.map(_alloc_state, t0.agent_state),
+                    actor_id=-1,
+                    param_version=0,
+                    task=np.empty((K, B), np.int32),
+                )
+            view = Trajectory(
+                obs=sb.obs[k],
+                first=sb.first[k],
+                actions=sb.actions[k],
+                behaviour_logits=sb.behaviour_logits[k],
+                rewards=sb.rewards[k],
+                cont=sb.cont[k],
+                agent_state=jax.tree.map(lambda x: x[k], sb.agent_state),
+                actor_id=-1,
+                param_version=0,
+                task=sb.task[k],
+            )
+            versions.append(
+                stack_trajectories(trajs, out=view).param_version
+            )
+        return sb._replace(param_version=min(versions))
+
+    def _batcher_loop_impl(self) -> None:
+        K = self._config.steps_per_dispatch
+        while not self._stop.is_set():
+            batch = (
+                self._assemble_batch()
+                if K == 1
+                else self._assemble_superbatch(K)
+            )
             if batch is None:
-                batch = stack_trajectories(trajs)
+                return
             if self._config.popart is not None:
                 bad = int(batch.task.max(initial=0))
                 if bad >= self._config.popart.num_values or batch.task.min(
                     initial=0
                 ) < 0:
                     raise ValueError(
-                        f"actor task ids {sorted(set(batch.task.tolist()))} "
+                        f"actor task ids "
+                        f"{sorted(set(batch.task.ravel().tolist()))} "
                         f"out of range for PopArt num_values="
                         f"{self._config.popart.num_values}"
                     )
@@ -428,17 +605,19 @@ class Learner:
             )
         )
         T = self._config.unroll_length
-        self.num_frames += T * self._config.batch_size
-        self.num_steps += 1
+        K = self._config.steps_per_dispatch
+        self.num_frames += T * self._config.batch_size * K
+        self.num_steps += K
         logs = dict(logs)
         logs["num_frames"] = self.num_frames
         logs["num_steps"] = self.num_steps
         logs["param_lag_frames"] = self.num_frames - batch_version
-        if self.num_steps % self._config.publish_interval == 0:
+        if crossed_interval(
+            self.num_steps, K, self._config.publish_interval
+        ):
             self._publish()
-        if (
-            self._logger is not None
-            and self.num_steps % self._config.log_interval == 0
+        if self._logger is not None and crossed_interval(
+            self.num_steps, K, self._config.log_interval
         ):
             now = time.monotonic()
             if self._last_log_t is not None:
@@ -481,16 +660,32 @@ class Learner:
         `watchdog` is invoked whenever no batch arrives within a second — it
         should raise if the producers are dead (SURVEY.md §6 failure
         detection) so a fully-stalled job fails loudly instead of hanging.
+
+        With `steps_per_dispatch=K > 1` each dispatch takes K SGD steps, so
+        the loop runs the largest multiple of K that fits in `max_steps` —
+        it never overshoots the budget (optax schedules and the frame
+        budget must line up with total_steps, loop.py's resume contract).
+        A non-multiple remainder is left unspent, loudly.
         """
         self.start()
+        K = self._config.steps_per_dispatch
+        if max_steps % K:
+            import warnings
+
+            warnings.warn(
+                f"step budget {max_steps} is not a multiple of "
+                f"steps_per_dispatch={K}; the final {max_steps % K} "
+                f"step(s) will not run",
+                stacklevel=2,
+            )
         steps_done = 0
         try:
-            while steps_done < max_steps:
+            while steps_done + K <= max_steps:
                 if stop_event is not None and stop_event.is_set():
                     break
                 try:
                     self.step_once(timeout=1.0)
-                    steps_done += 1
+                    steps_done += K
                 except queue.Empty:
                     if watchdog is not None:
                         watchdog()

@@ -50,3 +50,14 @@ class Trajectory(NamedTuple):
     actor_id: int = 0
     param_version: int = 0
     task: int = 0
+
+
+def crossed_interval(num_steps: int, delta: int, interval: int) -> bool:
+    """True iff advancing the step counter from `num_steps - delta` to
+    `num_steps` crossed a multiple of `interval`.
+
+    The interval check for fused dispatch: one dispatch advances the
+    counter by delta = steps_per_dispatch, so `num_steps % interval == 0`
+    would fire only when delta divides the interval; crossing-based checks
+    fire exactly once per boundary for any (delta, interval)."""
+    return (num_steps // interval) > ((num_steps - delta) // interval)
