@@ -182,6 +182,13 @@ def main() -> None:
                 result["mfu_estimate"] = fused_mfu
             elif "mfu_estimate" in result:
                 del result["mfu_estimate"]
+            # train_step_gflops stays valid: it is per SGD step, and the
+            # fused program's algebraic flops per step are identical —
+            # record that so readers don't scale it by K.
+            if "train_step_gflops" in result:
+                result["train_step_gflops_unit"] = (
+                    "per SGD step (K-invariant)"
+                )
     section("learner_deep_breakout", lambda: run_bench_deep(jax), gate=tpu_ok)
     section("learner_scaling", lambda: run_bench_scaling(jax), gate=tpu_ok)
     section(

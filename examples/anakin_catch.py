@@ -1,0 +1,61 @@
+"""Fully on-device training (Anakin) with fused dispatch.
+
+When the environment is pure JAX, the ENTIRE iteration — env stepping,
+policy sampling, V-trace, backward, optimizer — is one compiled XLA
+program; `updates_per_dispatch=4` additionally scans 4 such iterations
+per host dispatch. Catch reaches >0.9 mean return in a few seconds.
+
+Run from the repo root:  python examples/anakin_catch.py
+On a TPU host, delete the platform-forcing line; throughput then
+reflects the chip (millions of env-frames/s at these shapes).
+"""
+
+import os
+import sys
+
+# Make the repo root importable when running the example in place (with a
+# pip-installed package this block is unnecessary; sys.path rather than
+# PYTHONPATH because PYTHONPATH interferes with TPU plugin discovery on
+# some hosts).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # force CPU for portability
+
+import optax
+
+from torched_impala_tpu.envs import JaxCatch
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.ops import ImpalaLossConfig
+from torched_impala_tpu.runtime import AnakinConfig, AnakinRunner
+
+
+def main() -> None:
+    runner = AnakinRunner(
+        agent=Agent(
+            ImpalaNet(num_actions=3, torso=MLPTorso(hidden_sizes=(64,)))
+        ),
+        env=JaxCatch(),
+        optimizer=optax.rmsprop(5e-3, decay=0.99, eps=1e-7),
+        config=AnakinConfig(
+            num_envs=128,
+            unroll_length=16,
+            loss=ImpalaLossConfig(reduction="mean"),
+            updates_per_dispatch=4,
+        ),
+        rng=jax.random.key(0),
+    )
+    runner.step()  # compile
+    out = runner.run(20)  # 20 dispatches = 80 updates
+    print(
+        f"steps={out['num_steps']} frames={out['num_frames']} "
+        f"frames_per_sec={out['frames_per_sec']:,.0f} "
+        f"episode_return_mean={out['episode_return_mean']:.2f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

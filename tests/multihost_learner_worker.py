@@ -144,6 +144,51 @@ def main() -> None:
         flush=True,
     )
 
+    # Phase 3: fused dispatch across controllers — each host feeds K=2
+    # local batch slices; multihost.place_batch assembles the [K, T+1,
+    # B_global, ...] superbatch from host-local [K, T+1, B_local, ...]
+    # slices and ONE SPMD program scans both SGD steps. Same global loss
+    # on both controllers, num_steps advances by K.
+    K = 2
+    fused = Learner(
+        agent=Agent(ImpalaNet(num_actions=3, torso=MLPTorso())),
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(
+            batch_size=B_global,
+            unroll_length=T,
+            steps_per_dispatch=K,
+            queue_capacity=K * 4,
+        ),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+        mesh=mesh,
+    )
+    for i in range(K * 4):
+        rng = np.random.default_rng(1000 * process_id + i)
+        fused.enqueue(
+            Trajectory(
+                obs=rng.normal(size=(T + 1, 4)).astype(np.float32),
+                first=np.zeros((T + 1,), np.bool_),
+                actions=rng.integers(0, 3, size=(T,)).astype(np.int32),
+                behaviour_logits=rng.normal(size=(T, 3)).astype(np.float32),
+                rewards=rng.normal(size=(T,)).astype(np.float32),
+                cont=np.ones((T,), np.float32),
+                agent_state=(),
+                actor_id=process_id,
+                param_version=0,
+                task=0,
+            )
+        )
+    fused.start()
+    fused_logs = fused.step_once(timeout=300)
+    fused.stop()
+    assert fused.num_steps == K
+    print(
+        f"RESULT3 process={process_id} "
+        f"loss={float(fused_logs['total_loss']):.10f}",
+        flush=True,
+    )
+
 
 if __name__ == "__main__":
     main()

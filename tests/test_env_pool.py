@@ -253,6 +253,39 @@ class TestPooledVectorActor:
         for leaf in jax.tree.leaves(result.learner.params):
             assert leaf.sharding.is_fully_replicated
 
+    def test_train_process_mode_dp_fused_dispatch(self):
+        """The full production composition plus fused dispatch: worker
+        processes -> pooled inference -> in-place [K,...] superbatch ->
+        sharded device_put -> ONE pjit program scanning K SGD steps."""
+        from torched_impala_tpu.parallel import make_mesh
+
+        agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+        result = train(
+            agent=agent,
+            env_factory=discrete_factory,
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(
+                batch_size=4,
+                unroll_length=4,
+                steps_per_dispatch=2,
+            ),
+            optimizer=optax.sgd(1e-3),
+            total_steps=4,
+            envs_per_actor=2,
+            actor_mode="process",
+            actor_device=None,
+            log_every=1,
+            mesh=make_mesh(num_data=4),
+        )
+        assert result.learner.num_steps == 4  # 2 dispatches x K=2
+        assert result.num_frames == 4 * 4 * 4
+        assert np.isfinite(result.final_logs.get("total_loss", np.nan))
+        import jax
+
+        for leaf in jax.tree.leaves(result.learner.params):
+            assert leaf.sharding.is_fully_replicated
+
 
 class TestPoolRepairPaths:
     def test_reset_all_restarts_episodes_mid_flight(self):

@@ -50,7 +50,7 @@ def test_two_process_global_mesh_learner_step():
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
 
-    losses, loop_losses, seed_sets = [], [], []
+    losses, loop_losses, seed_sets, fused_losses = [], [], [], []
     for out in outs:
         lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
         assert len(lines) == 1, out
@@ -63,6 +63,11 @@ def test_two_process_global_mesh_learner_step():
             float(lines2[0].split("loss=")[1].split(" ")[0])
         )
         seed_sets.append(lines2[0].split("seeds=")[1])
+        lines3 = [
+            ln for ln in out.splitlines() if ln.startswith("RESULT3 ")
+        ]
+        assert len(lines3) == 1, out
+        fused_losses.append(float(lines3[0].split("loss=")[1]))
     # One global batch, one SPMD program: both controllers see THE loss.
     assert np.isfinite(losses[0])
     assert losses[0] == losses[1]
@@ -72,3 +77,7 @@ def test_two_process_global_mesh_learner_step():
     assert np.isfinite(loop_losses[0])
     assert loop_losses[0] == loop_losses[1]
     assert seed_sets[0] != seed_sets[1]
+    # Fused dispatch (steps_per_dispatch=2): the [K, ...] superbatch
+    # assembles across hosts and both controllers report THE same loss.
+    assert np.isfinite(fused_losses[0])
+    assert fused_losses[0] == fused_losses[1]

@@ -1,14 +1,24 @@
 #!/bin/bash
-# Watch for axon tunnel recovery; run bench.py the moment it heals.
+# Watch for axon tunnel recovery; capture + commit a fresh full bench the
+# moment it heals (includes fused-dispatch and anakin sections).
 cd /root/repo
-for i in $(seq 1 40); do
+for i in $(seq 1 60); do
   if timeout 150 python -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
     echo "$(date +%H:%M:%S) tunnel ALIVE (iter $i); running bench" >> /tmp/tunnel_watch.log
     timeout 3000 python bench.py > /root/repo/BENCH_watch.json 2> /tmp/bench_watch.log
-    echo "$(date +%H:%M:%S) bench rc=$? json=$(cat /root/repo/BENCH_watch.json | head -c 200)" >> /tmp/tunnel_watch.log
-    exit 0
+    rc=$?
+    echo "$(date +%H:%M:%S) bench rc=$rc json=$(head -c 200 /root/repo/BENCH_watch.json)" >> /tmp/tunnel_watch.log
+    if [ $rc -eq 0 ] && grep -q '"backend": "tpu"' /root/repo/BENCH_watch.json; then
+      cp /root/repo/BENCH_watch.json /root/repo/BENCH_live.json
+      git add BENCH_live.json BENCH_watch.json tunnel_watch.sh traces 2>/dev/null
+      git commit -m "bench: fresh real-chip capture after tunnel recovery (fused + anakin sections)" -- BENCH_live.json BENCH_watch.json tunnel_watch.sh traces >> /tmp/tunnel_watch.log 2>&1
+      echo "$(date +%H:%M:%S) committed fresh TPU bench" >> /tmp/tunnel_watch.log
+      exit 0
+    fi
+    echo "$(date +%H:%M:%S) bench did not reach TPU; continuing watch" >> /tmp/tunnel_watch.log
+  else
+    echo "$(date +%H:%M:%S) tunnel still wedged (iter $i)" >> /tmp/tunnel_watch.log
   fi
-  echo "$(date +%H:%M:%S) tunnel still wedged (iter $i)" >> /tmp/tunnel_watch.log
   sleep 600
 done
-echo "$(date +%H:%M:%S) gave up after 40 iters" >> /tmp/tunnel_watch.log
+echo "$(date +%H:%M:%S) gave up after 60 iters" >> /tmp/tunnel_watch.log
