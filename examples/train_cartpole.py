@@ -52,8 +52,15 @@ def main() -> None:
         seed=0,
     )
     returns = [r for _, r, _ in result.episode_returns]
-    early = np.mean(returns[: len(returns) // 4])
-    late = np.mean(returns[-len(returns) // 4 :])
+    if len(returns) < 8:
+        print(
+            f"only {len(returns)} episodes completed — too few for an "
+            f"early/late comparison (frames={result.num_frames})"
+        )
+        return
+    quarter = len(returns) // 4
+    early = np.mean(returns[:quarter])
+    late = np.mean(returns[-quarter:])
     print(
         f"episodes={len(returns)} early_return={early:.1f} "
         f"late_return={late:.1f} frames={result.num_frames}"

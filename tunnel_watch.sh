@@ -10,8 +10,8 @@ for i in $(seq 1 60); do
     echo "$(date +%H:%M:%S) bench rc=$rc json=$(head -c 200 /root/repo/BENCH_watch.json)" >> /tmp/tunnel_watch.log
     if [ $rc -eq 0 ] && grep -q '"backend": "tpu"' /root/repo/BENCH_watch.json; then
       cp /root/repo/BENCH_watch.json /root/repo/BENCH_live.json
-      git add BENCH_live.json BENCH_watch.json tunnel_watch.sh traces 2>/dev/null
-      git commit -m "bench: fresh real-chip capture after tunnel recovery (fused + anakin sections)" -- BENCH_live.json BENCH_watch.json tunnel_watch.sh traces >> /tmp/tunnel_watch.log 2>&1
+      git add BENCH_live.json BENCH_watch.json traces/bench 2>/dev/null
+      git commit -m "bench: fresh real-chip capture after tunnel recovery (fused + anakin sections)" -- BENCH_live.json BENCH_watch.json traces/bench >> /tmp/tunnel_watch.log 2>&1
       echo "$(date +%H:%M:%S) committed fresh TPU bench" >> /tmp/tunnel_watch.log
       exit 0
     fi
