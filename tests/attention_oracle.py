@@ -10,13 +10,32 @@ import jax
 import jax.numpy as jnp
 
 
-def dense_attention(q, k, v, causal):
+def dense_attention(q, k, v, causal, segment_ids=None):
+    """`segment_ids`: optional int32 `[T, B]`; queries attend only to
+    same-segment keys (episode-boundary isolation)."""
     T = q.shape[0]
     dh = q.shape[-1]
     logits = jnp.einsum("tbhd,sbhd->tbhs", q, k) / jnp.sqrt(float(dh))
     if causal:
         mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
         logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    if segment_ids is not None:
+        same = (
+            segment_ids[:, :, None]
+            == segment_ids.transpose(1, 0)[None, :, :]
+        )  # [T, B, T]
+        logits = jnp.where(same[:, :, None, :], logits, -1e30)
     return jnp.einsum(
         "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), v
     )
+
+
+def make_segments(rng, T, B, p=0.25):
+    """Contiguous per-row segment ids from random episode starts — the
+    transformer core's episode-counter semantics, pinned in one place for
+    every SP segment test."""
+    import numpy as np
+
+    firsts = rng.uniform(size=(T, B)) < p
+    firsts[0] = True
+    return jnp.asarray(np.cumsum(firsts.astype(np.int32), axis=0))

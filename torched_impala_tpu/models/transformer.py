@@ -3,8 +3,13 @@
 An alternative temporal core to the LSTM (the reference's recurrence is an
 LSTM; SURVEY.md §6 notes that if a transformer policy were added, sharding
 the time axis with collective-permute ring attention is the natural TPU
-path — `parallel/ring_attention.py` provides exactly that op). This core
-makes long-context policies first-class:
+path — `parallel/ring_attention.py` and `parallel/ulysses.py` provide
+those ops with this core's segment-id episode-boundary masking. They are
+the attention BUILDING BLOCK for a sequence-sharded unroll: a full
+drop-in for this core's attention would additionally need the rotary
+positions and the sliding-window KV-cache cross-attention threaded
+through, which remain dense-core-only today). This core makes
+long-context policies first-class:
 
 - **unroll mode** processes the whole `[T, B]` unroll in parallel (no
   sequential scan — attention is the transformer's advantage on the MXU);

@@ -43,6 +43,7 @@ def ring_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence.
 
@@ -51,6 +52,12 @@ def ring_attention(
         sequence sharded over `axis_name`.
       axis_name: mesh axis the sequence is sharded over.
       causal: mask position t from attending to positions > t (global).
+      segment_ids: optional int32 `[T_local, B]` — per-row segment id of
+        each step (the transformer core's episode counter,
+        models/transformer.py). Queries attend only to keys with the SAME
+        segment id, so episode boundaries inside a long unroll isolate
+        exactly as in the dense core. The ids rotate around the ring with
+        their KV block.
 
     Returns:
       `[T_local, B, H, Dh]` attention output for the local queries.
@@ -68,6 +75,7 @@ def ring_attention(
 
     perm = [(j, (j + 1) % n) for j in range(n)]
     k_blk, v_blk = k.astype(jnp.float32), v.astype(jnp.float32)
+    seg_blk = segment_ids
 
     q_pos = my * t_local + jnp.arange(t_local)  # global query positions
 
@@ -84,6 +92,11 @@ def ring_attention(
             logits = jnp.where(
                 visible[:, None, None, :], logits, NEG_INF
             )
+        if segment_ids is not None:
+            same_seg = (
+                segment_ids[:, :, None] == seg_blk.transpose(1, 0)[None]
+            )  # [Tl, B, Tl_kv]
+            logits = jnp.where(same_seg[:, :, None, :], logits, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         # Zero fully-masked entries explicitly: when an entire block is
         # masked, m_new can still be NEG_INF and exp(logit - m_new) would
@@ -104,6 +117,8 @@ def ring_attention(
         if i + 1 < n:
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            if seg_blk is not None:
+                seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
 
     return (acc / jnp.maximum(lse, 1e-30)[..., None]).astype(q.dtype)
 
@@ -126,16 +141,36 @@ def ring_attention_sharded(
     *,
     axis_name: str = "seq",
     causal: bool = True,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
-    """Global-view wrapper: q/k/v `[T_global, B, H, Dh]`; shards T over
-    `axis_name`, runs the ring, returns the global `[T_global, ...]`
-    result. T_global must divide evenly by the axis size."""
-    spec = P(axis_name)
-    fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal
+    """Global-view wrapper: q/k/v `[T_global, B, H, Dh]` (and optional
+    `segment_ids` `[T_global, B]`); shards T over `axis_name`, runs the
+    ring, returns the global `[T_global, ...]` result. T_global must
+    divide evenly by the axis size."""
+    return _shard_over_seq(
+        ring_attention, mesh, axis_name, causal, segment_ids, q, k, v
     )
+
+
+def _shard_over_seq(op, mesh, axis_name, causal, segment_ids, q, k, v):
+    """Shared global-view wrapper for both SP ops: shard every operand
+    (q/k/v and, when given, segment_ids) over `axis_name` and run `op`
+    under shard_map."""
+    spec = P(axis_name)
+    args = (q, k, v) + (() if segment_ids is None else (segment_ids,))
+
+    def fn(q, k, v, *rest):
+        return op(
+            q,
+            k,
+            v,
+            axis_name=axis_name,
+            causal=causal,
+            segment_ids=rest[0] if rest else None,
+        )
+
     sharded = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        fn, mesh=mesh, in_specs=(spec,) * len(args), out_specs=spec
     )
     put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
-    return sharded(put(q), put(k), put(v))
+    return sharded(*(put(x) for x in args))

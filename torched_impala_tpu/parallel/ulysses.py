@@ -45,6 +45,7 @@ def ulysses_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on `axis_name`.
 
@@ -53,6 +54,10 @@ def ulysses_attention(
         sequence. H must be divisible by the axis size.
       axis_name: mesh axis the sequence is sharded over.
       causal: standard causal masking over global positions.
+      segment_ids: optional int32 `[T_local, B]` per-row segment ids
+        (episode counters): queries attend only to same-segment keys.
+        All-gathered over the axis (ints are cheap next to the KV
+        all-to-alls) so the full mask is available to every head group.
 
     Returns:
       `[T_local, B, H, Dh]` attention output, sequence-sharded like q.
@@ -92,6 +97,14 @@ def ulysses_attention(
     if causal:
         visible = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
         logits = jnp.where(visible[:, None, None, :], logits, NEG_INF)
+    if segment_ids is not None:
+        seg_full = jax.lax.all_gather(
+            segment_ids, axis_name, axis=0, tiled=True
+        )  # [T, B]
+        same_seg = (
+            seg_full[:, :, None] == seg_full.transpose(1, 0)[None, :, :]
+        )  # [T, B, T]
+        logits = jnp.where(same_seg[:, :, None, :], logits, NEG_INF)
     out = jnp.einsum(
         "tbhs,sbhd->tbhd",
         jax.nn.softmax(logits, axis=-1),
@@ -109,17 +122,15 @@ def ulysses_attention_sharded(
     *,
     axis_name: str = "seq",
     causal: bool = True,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Global-view wrapper mirroring `ring_attention_sharded`: q/k/v
-    `[T_global, B, H, Dh]`; shards T over `axis_name`, re-shards across
-    the attention with all-to-alls, returns the global result. T_global
-    and H must divide evenly by the axis size."""
-    spec = P(axis_name)
-    fn = functools.partial(
-        ulysses_attention, axis_name=axis_name, causal=causal
+    `[T_global, B, H, Dh]` (and optional `segment_ids` `[T_global, B]`);
+    shards T over `axis_name`, re-shards across the attention with
+    all-to-alls, returns the global result. T_global and H must divide
+    evenly by the axis size."""
+    from torched_impala_tpu.parallel.ring_attention import _shard_over_seq
+
+    return _shard_over_seq(
+        ulysses_attention, mesh, axis_name, causal, segment_ids, q, k, v
     )
-    sharded = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )
-    put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
-    return sharded(put(q), put(k), put(v))
