@@ -192,6 +192,44 @@ class TestCLI:
         ])
         assert rc == 0
 
+    def test_env_id_and_dispatch_overrides(self):
+        """--env-id and --steps-per-dispatch reach the built config (the
+        per-game override an Atari-57 sweep over one preset needs). With
+        --fake-envs the action-space probe is skipped (fakes follow the
+        preset constants); without it, build_config probes ONE real env
+        so the policy head matches the substituted game's action space."""
+        from torched_impala_tpu.run import build_config, parse_args
+
+        args = parse_args(
+            [
+                "--config",
+                "pong",
+                "--env-id",
+                "BreakoutNoFrameskip-v4",
+                "--steps-per-dispatch",
+                "4",
+                "--fake-envs",
+            ]
+        )
+        cfg = build_config(args)
+        assert cfg.env_id == "BreakoutNoFrameskip-v4"
+        assert cfg.steps_per_dispatch == 4
+        assert cfg.num_actions == 6  # fake mode: preset constant
+
+        # Real probe path on the one family installed here: cartpole's
+        # action space is 2 and must survive the probe unchanged.
+        args = parse_args(
+            ["--config", "cartpole", "--env-id", "CartPole-v1"]
+        )
+        cfg = build_config(args)
+        assert cfg.num_actions == 2
+
+    def test_probe_num_actions_reads_real_env(self):
+        from torched_impala_tpu import configs
+
+        cfg = configs.REGISTRY["cartpole"]
+        assert configs.probe_num_actions(cfg) == 2
+
     def test_unknown_config_errors(self):
         with pytest.raises(SystemExit):
             cli_main(["--config", "nope"])

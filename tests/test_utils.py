@@ -258,3 +258,16 @@ def test_restore_tolerates_checkpoint_without_rng(tmp_path):
     assert restored is not None and "rng" not in restored
     np.testing.assert_array_equal(restored["params"], state["params"])
     assert int(restored["num_steps"]) == 3
+
+
+def test_file_loggers_create_missing_directories(tmp_path):
+    """--logdir points at a not-yet-existing directory on first runs; both
+    file loggers must create it instead of crashing on open()."""
+    deep = tmp_path / "a" / "b"
+    csv_lg = CSVLogger(str(deep / "m.csv"))
+    csv_lg.write({"x": 1.0})
+    csv_lg.close()
+    jl = JSONLinesLogger(str(deep / "m.jsonl"))
+    jl.write({"x": 1.0})
+    jl.close()
+    assert (deep / "m.csv").exists() and (deep / "m.jsonl").exists()

@@ -305,6 +305,22 @@ def make_env_factory(
     return _EnvFactory(cfg, fake)
 
 
+def probe_num_actions(cfg: ExperimentConfig) -> int:
+    """Construct ONE real env for `cfg` and return its action-space size.
+
+    Needed when `--env-id` overrides a preset's env: the preset's
+    `num_actions` constant describes the ORIGINAL game, and building the
+    policy head from it would sample out-of-range (or unreachable)
+    actions for the substituted one (e.g. pong's 6 vs Breakout's 4)."""
+    env = _EnvFactory(cfg, fake=False)(seed=0, env_index=0)
+    try:
+        return int(env.action_space.n)
+    finally:
+        close = getattr(env, "close", None)
+        if close is not None:
+            close()
+
+
 # ---- the five BASELINE.json presets ------------------------------------
 
 CARTPOLE = ExperimentConfig(

@@ -27,7 +27,6 @@ dense-equivalence oracle.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -29,7 +29,6 @@ rides for expert parallelism, so it is ICI-efficient by construction.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

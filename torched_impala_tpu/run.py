@@ -61,6 +61,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
     # Environments.
+    p.add_argument("--env-id", default=None,
+                   help="override the preset's env id (e.g. a different "
+                        "ALE game for an Atari-57 sweep over the pong/"
+                        "breakout presets)")
     p.add_argument("--fake-envs", action="store_true",
                    help="substitute shape-faithful fake envs (no emulators)")
     p.add_argument("--chaos", type=int, default=0, metavar="N",
@@ -112,11 +116,27 @@ def build_config(args: argparse.Namespace):
         ("total_env_frames", "total_env_frames"),
         ("lr", "lr"),
         ("dp", "dp_devices"),
+        ("env_id", "env_id"),
     ):
         v = getattr(args, flag)
         if v is not None:
             overrides[field] = v
-    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+    cfg = dataclasses.replace(cfg, **overrides) if overrides else cfg
+    if args.env_id is not None and not args.fake_envs:
+        # The preset's num_actions describes its ORIGINAL env; a
+        # substituted game's action space can differ (pong 6 vs breakout
+        # 4), and the policy head must match the env the actors step.
+        from torched_impala_tpu.configs import probe_num_actions
+
+        real = probe_num_actions(cfg)
+        if real != cfg.num_actions:
+            print(
+                f"--env-id {args.env_id}: num_actions {cfg.num_actions} "
+                f"(preset) -> {real} (probed from the env)",
+                file=sys.stderr,
+            )
+            cfg = dataclasses.replace(cfg, num_actions=real)
+    return cfg
 
 
 def make_logger(args: argparse.Namespace):

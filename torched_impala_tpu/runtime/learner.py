@@ -249,7 +249,15 @@ class Learner:
         self.num_frames = 0
         self.num_steps = 0
 
-        capacity = config.queue_capacity or config.batch_size * 2
+        # Default bounds actor lead at two dispatches' worth of unrolls: a
+        # fused dispatch consumes K*B at once, so the K=1 default of 2*B
+        # would make actors trickle unrolls through a too-small queue
+        # during superbatch assembly instead of accumulating the next
+        # dispatch's K*B while the current one computes.
+        capacity = (
+            config.queue_capacity
+            or config.batch_size * 2 * config.steps_per_dispatch
+        )
         self._traj_q: queue.Queue = queue.Queue(maxsize=capacity)
         self._batch_q: queue.Queue = queue.Queue(
             maxsize=config.device_queue_depth

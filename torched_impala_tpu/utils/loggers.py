@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import csv
 import json
+import os
 import sys
 import time
 from typing import IO, Mapping, Optional, Sequence
@@ -83,6 +84,9 @@ class CSVLogger(Logger):
     def write(self, metrics: Mapping[str, object]) -> None:
         if self._writer is None:
             self._fields = list(metrics.keys())
+            os.makedirs(
+                os.path.dirname(os.path.abspath(self._path)), exist_ok=True
+            )
             self._file = open(self._path, "w", newline="")
             self._writer = csv.DictWriter(
                 self._file, fieldnames=self._fields, extrasaction="ignore"
@@ -104,6 +108,9 @@ class JSONLinesLogger(Logger):
     """One JSON object per line — the machine-readable training log."""
 
     def __init__(self, path: str):
+        os.makedirs(
+            os.path.dirname(os.path.abspath(path)), exist_ok=True
+        )
         self._file: IO[str] = open(path, "a")
 
     def write(self, metrics: Mapping[str, object]) -> None:
