@@ -10,9 +10,21 @@ import jax
 import jax.numpy as jnp
 
 
-def dense_attention(q, k, v, causal, segment_ids=None):
+def dense_attention(
+    q,
+    k,
+    v,
+    causal,
+    segment_ids=None,
+    prefix_k=None,
+    prefix_v=None,
+    prefix_seg=None,
+):
     """`segment_ids`: optional int32 `[T, B]`; queries attend only to
-    same-segment keys (episode-boundary isolation)."""
+    same-segment keys (episode-boundary isolation). `prefix_*`: optional
+    strictly-past context block `[S, B, H, Dh]` (+ `[S, B]` segment ids,
+    -1 = empty slot) every query may attend to, subject to segment
+    match — the transformer core's KV-cache semantics."""
     T = q.shape[0]
     dh = q.shape[-1]
     logits = jnp.einsum("tbhd,sbhd->tbhs", q, k) / jnp.sqrt(float(dh))
@@ -25,8 +37,21 @@ def dense_attention(q, k, v, causal, segment_ids=None):
             == segment_ids.transpose(1, 0)[None, :, :]
         )  # [T, B, T]
         logits = jnp.where(same[:, :, None, :], logits, -1e30)
+    values = v
+    if prefix_k is not None:
+        plogits = jnp.einsum(
+            "tbhd,sbhd->tbhs", q, prefix_k
+        ) / jnp.sqrt(float(dh))
+        if prefix_seg is not None:
+            vis = (
+                segment_ids[:, :, None]
+                == prefix_seg.transpose(1, 0)[None, :, :]
+            )  # [T, B, S]
+            plogits = jnp.where(vis[:, :, None, :], plogits, -1e30)
+        logits = jnp.concatenate([plogits, logits], axis=-1)
+        values = jnp.concatenate([prefix_v, v], axis=0)
     return jnp.einsum(
-        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), v
+        "tbhs,sbhd->tbhd", jax.nn.softmax(logits, axis=-1), values
     )
 
 

@@ -131,5 +131,61 @@ class TestEquivalence:
             )
 
 
+    def test_prefix_cache_matches_dense(self):
+        """The transformer core's KV-cache semantics under SP: a
+        strictly-past prefix block (segment-gated, -1 = empty slot) plus
+        the sharded sequence must equal the dense concat oracle."""
+        rng = np.random.default_rng(17)
+        T, B, H, Dh, S = 16, 2, 2, 8, 6
+        q, k, v = _qkv(rng, T)
+        seg = make_segments(rng, T, B)
+        pk = jnp.asarray(rng.normal(size=(S, B, H, Dh)), jnp.float32)
+        pv = jnp.asarray(rng.normal(size=(S, B, H, Dh)), jnp.float32)
+        # Prefix slots: some carry the FIRST segment of each row (the
+        # episode continuing from the previous unroll), some are empty.
+        pseg_np = np.full((S, B), -1, np.int32)
+        pseg_np[3:] = np.asarray(seg)[0]  # last 3 slots join episode 1
+        pseg = jnp.asarray(pseg_np)
+        mesh = seq_mesh(4)
+        out = ring_attention_sharded(
+            q, k, v, mesh, causal=True, segment_ids=seg,
+            prefix_k=pk, prefix_v=pv, prefix_seg=pseg,
+        )
+        ref = dense_attention(
+            q, k, v, True, segment_ids=seg,
+            prefix_k=pk, prefix_v=pv, prefix_seg=pseg,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])
+
+
+def test_partial_prefix_combinations_rejected():
+    """The prefix contract fails loudly everywhere: k without v, v alone,
+    seg alone, and segment/prefix_seg mismatches are all errors — never a
+    silent no-prefix fallback."""
+    from torched_impala_tpu.parallel.ring_attention import validate_prefix
+    from torched_impala_tpu.parallel import ulysses_attention_sharded
+
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, 8)
+    pk = jnp.zeros((2, 2, 2, 8), jnp.float32)
+    seg = make_segments(rng, 8, 2)
+    mesh = seq_mesh(2)
+    for fn in (ring_attention_sharded, ulysses_attention_sharded):
+        with pytest.raises(ValueError):
+            fn(q, k, v, mesh, prefix_k=pk)  # k without v
+        with pytest.raises(ValueError):
+            fn(q, k, v, mesh, prefix_v=pk)  # v alone
+        with pytest.raises(ValueError):
+            fn(q, k, v, mesh, prefix_seg=jnp.zeros((2, 2), jnp.int32))
+        with pytest.raises(ValueError):
+            # prefix + segment_ids but no prefix_seg
+            fn(q, k, v, mesh, segment_ids=seg, prefix_k=pk, prefix_v=pk)
+    # The helper itself accepts the two complete combinations.
+    validate_prefix(None, pk, pk, None)
+    validate_prefix(seg, pk, pk, jnp.zeros((2, 2), jnp.int32))

@@ -4,12 +4,15 @@ An alternative temporal core to the LSTM (the reference's recurrence is an
 LSTM; SURVEY.md §6 notes that if a transformer policy were added, sharding
 the time axis with collective-permute ring attention is the natural TPU
 path — `parallel/ring_attention.py` and `parallel/ulysses.py` provide
-those ops with this core's segment-id episode-boundary masking. They are
-the attention BUILDING BLOCK for a sequence-sharded unroll: a full
-drop-in for this core's attention would additionally need the rotary
-positions and the sliding-window KV-cache cross-attention threaded
-through, which remain dense-core-only today). This core makes
-long-context policies first-class:
+those ops with this core's full attention semantics: segment-id
+episode-boundary masking AND the sliding-window KV-cache cross-attention
+as a replicated `prefix_*` block (cache slots seg-gated, -1 = empty).
+Rotary positions are applied at projection time in this core — before
+attention — so they need nothing from the SP ops. What remains for a
+full sequence-sharded core is plumbing, not math: reshaping this core's
+`[B, T, D]` projections to the ops' `[T, B, H, Dh]` and carrying the
+window-truncation bookkeeping). This core makes long-context policies
+first-class:
 
 - **unroll mode** processes the whole `[T, B]` unroll in parallel (no
   sequential scan — attention is the transformer's advantage on the MXU);

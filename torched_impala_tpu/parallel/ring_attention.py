@@ -35,6 +35,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
+def validate_prefix(segment_ids, prefix_k, prefix_v, prefix_seg) -> None:
+    """One complete contract for the optional KV-cache prefix, shared by
+    both SP ops and the sharded wrapper so partial argument combinations
+    fail loudly everywhere instead of silently dropping the cache."""
+    if (prefix_k is None) != (prefix_v is None):
+        raise ValueError("prefix needs BOTH prefix_k and prefix_v")
+    if prefix_seg is not None and prefix_k is None:
+        raise ValueError("prefix_seg given without prefix_k/prefix_v")
+    if prefix_k is not None and (segment_ids is None) != (
+        prefix_seg is None
+    ):
+        raise ValueError(
+            "prefix with segments needs BOTH segment_ids and prefix_seg"
+        )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -43,6 +59,9 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     segment_ids: jax.Array | None = None,
+    prefix_k: jax.Array | None = None,
+    prefix_v: jax.Array | None = None,
+    prefix_seg: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence.
 
@@ -57,10 +76,20 @@ def ring_attention(
         segment id, so episode boundaries inside a long unroll isolate
         exactly as in the dense core. The ids rotate around the ring with
         their KV block.
+      prefix_k, prefix_v: optional `[S, B, H, Dh]` context block that is
+        strictly in the PAST of every query — the transformer core's
+        sliding-window KV cache carried in from the previous unroll.
+        Replicated across the seq axis (B is not sharded here; S = cache
+        window is small), processed locally before the ring rounds — no
+        extra collective.
+      prefix_seg: optional int32 `[S, B]` segment ids of the prefix slots
+        (the core's kv_seg, -1 = empty slot which matches no query).
+        Required iff `segment_ids` is given alongside a prefix.
 
     Returns:
       `[T_local, B, H, Dh]` attention output for the local queries.
     """
+    validate_prefix(segment_ids, prefix_k, prefix_v, prefix_seg)
     n = jax.lax.psum(1, axis_name)  # devices on the ring (static)
     my = jax.lax.axis_index(axis_name)
     t_local = q.shape[0]
@@ -72,6 +101,50 @@ def ring_attention(
     m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)  # [Tl, B, H]
     lse = jnp.zeros(q.shape[:3], jnp.float32)
 
+    def accumulate(state, k_blk, v_blk, visible):
+        """One online-softmax update of (m, lse, acc) against a KV block;
+        `visible` is a bool [Tl, B, Tl_kv] (or None = all visible)."""
+        m, lse, acc = state
+        logits = (
+            jnp.einsum("tbhd,sbhd->tbhs", q32, k_blk) * scale
+        )  # [Tl, B, H, S]
+        if visible is not None:
+            logits = jnp.where(visible[:, :, None, :], logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # Zero fully-masked entries explicitly: when an entire block is
+        # masked, m_new can still be NEG_INF and exp(logit - m_new) would
+        # be exp(0) = 1 for masked slots.
+        p = jnp.where(
+            logits <= NEG_INF / 2,
+            0.0,
+            jnp.exp(logits - m_new[..., None]),
+        )
+        correction = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        lse = lse * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "tbhs,sbhd->tbhd", p, v_blk
+        )
+        return m_new, lse, acc
+
+    state = (m, lse, acc)
+
+    # Cache prefix first: strictly-past context, no causal test needed —
+    # only segment identity gates visibility (empty slots carry seg -1,
+    # which never equals a real episode counter).
+    if prefix_k is not None:
+        vis = None
+        if prefix_seg is not None:
+            vis = (
+                segment_ids[:, :, None]
+                == prefix_seg.transpose(1, 0)[None]
+            )  # [Tl, B, S]
+        state = accumulate(
+            state,
+            prefix_k.astype(jnp.float32),
+            prefix_v.astype(jnp.float32),
+            vis,
+        )
+
     perm = [(j, (j + 1) % n) for j in range(n)]
     k_blk, v_blk = k.astype(jnp.float32), v.astype(jnp.float32)
     seg_blk = segment_ids
@@ -82,43 +155,28 @@ def ring_attention(
         # Which global block this KV came from: after i rotations a device
         # holds the block originally owned by (my - i) mod n.
         src = (my - i) % n
-        logits = (
-            jnp.einsum("tbhd,sbhd->tbhs", q32, k_blk) * scale
-        )  # [Tl, B, H, Tl_kv]
+        visible = None
         if causal:
             k_pos = src * t_local + jnp.arange(t_local)
-            visible = q_pos[:, None] >= k_pos[None, :]  # [Tl, Tl_kv]
-            logits = jnp.where(
-                visible[:, None, None, :], logits, NEG_INF
-            )
+            visible = jnp.broadcast_to(
+                (q_pos[:, None] >= k_pos[None, :])[:, None, :],
+                (t_local, q.shape[1], t_local),
+            )  # [Tl, B, Tl_kv]
         if segment_ids is not None:
             same_seg = (
                 segment_ids[:, :, None] == seg_blk.transpose(1, 0)[None]
             )  # [Tl, B, Tl_kv]
-            logits = jnp.where(same_seg[:, :, None, :], logits, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-        # Zero fully-masked entries explicitly: when an entire block is
-        # masked, m_new can still be NEG_INF and exp(logit - m_new) would
-        # be exp(0) = 1 for masked slots.
-        p = jnp.where(
-            logits <= NEG_INF / 2,
-            0.0,
-            jnp.exp(logits - m_new[..., None]),
-        )
-        correction = jnp.where(
-            m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new)
-        )
-        lse = lse * correction + jnp.sum(p, axis=-1)
-        acc = acc * correction[..., None] + jnp.einsum(
-            "tbhs,sbhd->tbhd", p, v_blk
-        )
-        m = m_new
+            visible = (
+                same_seg if visible is None else (visible & same_seg)
+            )
+        state = accumulate(state, k_blk, v_blk, visible)
         if i + 1 < n:
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
             if seg_blk is not None:
                 seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
 
+    m, lse, acc = state
     return (acc / jnp.maximum(lse, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -141,35 +199,80 @@ def ring_attention_sharded(
     axis_name: str = "seq",
     causal: bool = True,
     segment_ids: jax.Array | None = None,
+    prefix_k: jax.Array | None = None,
+    prefix_v: jax.Array | None = None,
+    prefix_seg: jax.Array | None = None,
 ) -> jax.Array:
     """Global-view wrapper: q/k/v `[T_global, B, H, Dh]` (and optional
-    `segment_ids` `[T_global, B]`); shards T over `axis_name`, runs the
-    ring, returns the global `[T_global, ...]` result. T_global must
-    divide evenly by the axis size."""
+    `segment_ids` `[T_global, B]`, `prefix_*` cache block — replicated,
+    see `ring_attention`); shards T over `axis_name`, runs the ring,
+    returns the global `[T_global, ...]` result. T_global must divide
+    evenly by the axis size."""
     return _shard_over_seq(
-        ring_attention, mesh, axis_name, causal, segment_ids, q, k, v
+        ring_attention,
+        mesh,
+        axis_name,
+        causal,
+        segment_ids,
+        q,
+        k,
+        v,
+        prefix_k=prefix_k,
+        prefix_v=prefix_v,
+        prefix_seg=prefix_seg,
     )
 
 
-def _shard_over_seq(op, mesh, axis_name, causal, segment_ids, q, k, v):
-    """Shared global-view wrapper for both SP ops: shard every operand
-    (q/k/v and, when given, segment_ids) over `axis_name` and run `op`
-    under shard_map."""
+def _shard_over_seq(
+    op,
+    mesh,
+    axis_name,
+    causal,
+    segment_ids,
+    q,
+    k,
+    v,
+    *,
+    prefix_k=None,
+    prefix_v=None,
+    prefix_seg=None,
+):
+    """Shared global-view wrapper for both SP ops: q/k/v (and, when
+    given, segment_ids) are sharded over `axis_name`; prefix operands are
+    replicated (the cache block is whole on every device)."""
     spec = P(axis_name)
-    args = (q, k, v) + (() if segment_ids is None else (segment_ids,))
+    seq_args = (q, k, v) + (() if segment_ids is None else (segment_ids,))
+    n_seq = len(seq_args)
+    pre_args = tuple(
+        x for x in (prefix_k, prefix_v, prefix_seg) if x is not None
+    )
+    validate_prefix(segment_ids, prefix_k, prefix_v, prefix_seg)
+    has_seg = segment_ids is not None
+    has_prefix = prefix_k is not None
+    has_pseg = prefix_seg is not None
 
-    def fn(q, k, v, *rest):
+    def fn(*args):
+        rest = args[n_seq:]
         return op(
-            q,
-            k,
-            v,
+            args[0],
+            args[1],
+            args[2],
             axis_name=axis_name,
             causal=causal,
-            segment_ids=rest[0] if rest else None,
+            segment_ids=args[3] if has_seg else None,
+            prefix_k=rest[0] if has_prefix else None,
+            prefix_v=rest[1] if has_prefix else None,
+            prefix_seg=rest[2] if has_pseg else None,
         )
 
     sharded = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec,) * len(args), out_specs=spec
+        fn,
+        mesh=mesh,
+        in_specs=(spec,) * n_seq + (P(),) * len(pre_args),
+        out_specs=spec,
     )
-    put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
-    return sharded(*(put(x) for x in args))
+    put_s = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
+    put_r = lambda x: jax.device_put(x, NamedSharding(mesh, P()))  # noqa: E731
+    return sharded(
+        *(put_s(x) for x in seq_args), *(put_r(x) for x in pre_args)
+    )

@@ -45,6 +45,9 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = True,
     segment_ids: jax.Array | None = None,
+    prefix_k: jax.Array | None = None,
+    prefix_v: jax.Array | None = None,
+    prefix_seg: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on `axis_name`.
 
@@ -57,10 +60,18 @@ def ulysses_attention(
         (episode counters): queries attend only to same-segment keys.
         All-gathered over the axis (ints are cheap next to the KV
         all-to-alls) so the full mask is available to every head group.
+      prefix_k, prefix_v: optional `[S, B, H, Dh]` strictly-past context
+        block (the transformer core's KV cache), replicated across the
+        axis; each device attends its HEAD GROUP's slice of it.
+      prefix_seg: optional int32 `[S, B]` prefix segment ids (-1 = empty
+        slot). Required iff `segment_ids` is given alongside a prefix.
 
     Returns:
       `[T_local, B, H, Dh]` attention output, sequence-sharded like q.
     """
+    from torched_impala_tpu.parallel.ring_attention import validate_prefix
+
+    validate_prefix(segment_ids, prefix_k, prefix_v, prefix_seg)
     n = jax.lax.psum(1, axis_name)
     h = q.shape[2]
     if h % n:
@@ -96,6 +107,7 @@ def ulysses_attention(
     if causal:
         visible = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
         logits = jnp.where(visible[:, None, None, :], logits, NEG_INF)
+    seg_full = None
     if segment_ids is not None:
         seg_full = jax.lax.all_gather(
             segment_ids, axis_name, axis=0, tiled=True
@@ -104,10 +116,38 @@ def ulysses_attention(
             seg_full[:, :, None] == seg_full.transpose(1, 0)[None, :, :]
         )  # [T, B, T]
         logits = jnp.where(same_seg[:, :, None, :], logits, NEG_INF)
+    values = vh
+    if prefix_k is not None:
+        # The prefix carries all H heads; this device computes only its
+        # head group — slice the group out (group index = axis position).
+        my = jax.lax.axis_index(axis_name)
+        hg = h // n
+        pk = jax.lax.dynamic_slice_in_dim(
+            prefix_k, my * hg, hg, axis=2
+        )  # [S, B, hg, Dh]
+        pv = jax.lax.dynamic_slice_in_dim(prefix_v, my * hg, hg, axis=2)
+        plogits = (
+            jnp.einsum(
+                "tbhd,sbhd->tbhs",
+                qh,
+                pk,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [T, B, hg, S]
+        if prefix_seg is not None:
+            vis = (
+                seg_full[:, :, None] == prefix_seg.transpose(1, 0)[None]
+            )  # [T, B, S]
+            plogits = jnp.where(vis[:, :, None, :], plogits, NEG_INF)
+        # Prefix is strictly past: no causal test; one softmax over the
+        # concatenated (prefix + sequence) key axis keeps it exact.
+        logits = jnp.concatenate([plogits, logits], axis=-1)
+        values = jnp.concatenate([pv.astype(vh.dtype), vh], axis=0)
     out = jnp.einsum(
         "tbhs,sbhd->tbhd",
         jax.nn.softmax(logits, axis=-1),
-        vh,
+        values,
         preferred_element_type=jnp.float32,
     )
     return to_seq(out).astype(q.dtype)
@@ -122,14 +162,27 @@ def ulysses_attention_sharded(
     axis_name: str = "seq",
     causal: bool = True,
     segment_ids: jax.Array | None = None,
+    prefix_k: jax.Array | None = None,
+    prefix_v: jax.Array | None = None,
+    prefix_seg: jax.Array | None = None,
 ) -> jax.Array:
     """Global-view wrapper mirroring `ring_attention_sharded`: q/k/v
-    `[T_global, B, H, Dh]` (and optional `segment_ids` `[T_global, B]`);
-    shards T over `axis_name`, re-shards across the attention with
-    all-to-alls, returns the global result. T_global and H must divide
-    evenly by the axis size."""
+    `[T_global, B, H, Dh]` (and optional `segment_ids` `[T_global, B]`,
+    `prefix_*` cache block — replicated); shards T over `axis_name`,
+    re-shards across the attention with all-to-alls, returns the global
+    result. T_global and H must divide evenly by the axis size."""
     from torched_impala_tpu.parallel.ring_attention import _shard_over_seq
 
     return _shard_over_seq(
-        ulysses_attention, mesh, axis_name, causal, segment_ids, q, k, v
+        ulysses_attention,
+        mesh,
+        axis_name,
+        causal,
+        segment_ids,
+        q,
+        k,
+        v,
+        prefix_k=prefix_k,
+        prefix_v=prefix_v,
+        prefix_seg=prefix_seg,
     )

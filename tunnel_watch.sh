@@ -3,6 +3,14 @@
 # moment it heals (includes fused-dispatch and anakin sections).
 cd /root/repo
 for i in $(seq 1 60); do
+  # ONE TPU client at a time: if a bench is already running (e.g. the
+  # round driver's), skip this iteration entirely — even the probe is a
+  # tunnel client.
+  if pgrep -f "python bench.py" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) bench already running; skipping probe (iter $i)" >> /tmp/tunnel_watch.log
+    sleep 600
+    continue
+  fi
   if timeout 150 python -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
     echo "$(date +%H:%M:%S) tunnel ALIVE (iter $i); running bench" >> /tmp/tunnel_watch.log
     timeout 3000 python bench.py > /root/repo/BENCH_watch.json 2> /tmp/bench_watch.log
