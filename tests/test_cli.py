@@ -357,3 +357,43 @@ class TestAnakinCLI:
             "--eval-episodes", "2",
         ])
         assert rc == 0
+
+
+class TestSweep:
+    def test_suite_and_arg_plumbing(self):
+        """The sweep driver's pure parts: 57-game suite, env-id naming,
+        arg parsing (the ALE-dependent paths are gated)."""
+        from torched_impala_tpu import sweep
+
+        assert len(sweep.ATARI_57) == 57
+        assert len(set(sweep.ATARI_57)) == 57
+        assert sweep.game_env_id("Pong") == "PongNoFrameskip-v4"
+        args = sweep.parse_args(
+            ["--config", "pong", "--games", "Pong", "Breakout",
+             "--out", "/tmp/x.csv", "--", "--platform", "cpu"]
+        )
+        assert args.games == ["Pong", "Breakout"]
+        assert "--platform" in args.extra
+
+    def test_requires_ale(self):
+        """On a host without ale-py the sweep exits with a clear error
+        instead of crashing mid-run."""
+        from torched_impala_tpu import sweep
+
+        with pytest.raises(SystemExit, match="ale-py"):
+            sweep.main(["--games", "Pong"])
+
+    def test_sweep_resume_preserves_recorded_rows(self, tmp_path):
+        """A resumed sweep must never destroy recorded results: rows with
+        a mean_return are re-written up front and their games skipped."""
+        from torched_impala_tpu import sweep
+
+        out = tmp_path / "sweep.csv"
+        out.write_text(
+            "game,env_id,train_rc,eval_rc,mean_return,error\n"
+            "Pong,PongNoFrameskip-v4,0,0,19.5,\n"
+            "Breakout,BreakoutNoFrameskip-v4,1,,,boom\n"
+        )
+        done = sweep.load_done_rows(str(out))
+        assert set(done) == {"Pong"}  # error row (no return) is retried
+        assert float(done["Pong"]["mean_return"]) == 19.5

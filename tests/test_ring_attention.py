@@ -160,8 +160,6 @@ class TestEquivalence:
         )
 
 
-if __name__ == "__main__":
-    pytest.main([__file__, "-q"])
 
 
 def test_partial_prefix_combinations_rejected():
@@ -189,3 +187,7 @@ def test_partial_prefix_combinations_rejected():
     # The helper itself accepts the two complete combinations.
     validate_prefix(None, pk, pk, None)
     validate_prefix(seg, pk, pk, jnp.zeros((2, 2), jnp.int32))
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -1,0 +1,170 @@
+"""Atari-57 sweep driver: train/eval one preset across the 57-game suite.
+
+The primary metric is "learner frames/sec/chip on Atari-57; return parity
+@200M frames" (BASELINE.md). This driver runs the per-game half: for each
+game it invokes the normal CLI (`run.py`) with `--env-id` (which probes
+the game's action space and resizes the policy head), a per-game
+checkpoint dir, and then greedy eval — collecting one CSV row per game.
+
+Usage (ALE-equipped host):
+
+    python -m torched_impala_tpu.sweep --config pong \
+        --out runs/atari57.csv --total-env-frames 200000000 \
+        [--games Pong Breakout ...] [--eval-only] [-- <extra run.py flags>]
+
+Games default to the standard 57-game suite; names are bare (e.g.
+"Pong") and expand to `<Game>NoFrameskip-v4`. Each game trains
+sequentially (one TPU client at a time); a sweep is resumable at two
+levels — games already holding a `mean_return` row in `--out` are
+skipped entirely (their rows are preserved), and a partially-trained
+game picks its checkpoint back up via run.py `--resume`. Requires
+ale-py (gated with a clear error, like envs/factory.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import re
+import subprocess
+import sys
+
+# The canonical 57-game Atari suite (ALE naming).
+ATARI_57 = [
+    "Alien", "Amidar", "Assault", "Asterix", "Asteroids", "Atlantis",
+    "BankHeist", "BattleZone", "BeamRider", "Berzerk", "Bowling", "Boxing",
+    "Breakout", "Centipede", "ChopperCommand", "CrazyClimber", "Defender",
+    "DemonAttack", "DoubleDunk", "Enduro", "FishingDerby", "Freeway",
+    "Frostbite", "Gopher", "Gravitar", "Hero", "IceHockey", "Jamesbond",
+    "Kangaroo", "Krull", "KungFuMaster", "MontezumaRevenge", "MsPacman",
+    "NameThisGame", "Phoenix", "Pitfall", "Pong", "PrivateEye", "Qbert",
+    "Riverraid", "RoadRunner", "Robotank", "Seaquest", "Skiing",
+    "Solaris", "SpaceInvaders", "StarGunner", "Surround", "Tennis",
+    "TimePilot", "Tutankham", "UpNDown", "Venture", "VideoPinball",
+    "WizardOfWor", "YarsRevenge", "Zaxxon",
+]
+
+
+def game_env_id(game: str) -> str:
+    return f"{game}NoFrameskip-v4"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="pong",
+                   help="preset each game rides (model/optimizer/scale)")
+    p.add_argument("--games", nargs="*", default=None,
+                   help="subset of games (default: the 57-game suite)")
+    p.add_argument("--out", default="atari57.csv")
+    p.add_argument("--workdir", default="runs/atari57",
+                   help="per-game checkpoints/logs live under here")
+    p.add_argument("--total-env-frames", type=int, default=None)
+    p.add_argument("--eval-episodes", type=int, default=30)
+    p.add_argument("--eval-only", action="store_true",
+                   help="skip training; eval existing checkpoints")
+    p.add_argument("extra", nargs=argparse.REMAINDER,
+                   help="flags after '--' pass through to run.py")
+    return p.parse_args(argv)
+
+
+def require_ale() -> None:
+    try:
+        import ale_py  # noqa: F401
+    except ImportError as e:
+        raise SystemExit(
+            "the Atari-57 sweep needs ale-py (pip install ale-py "
+            "gymnasium[atari]); this host doesn't have it"
+        ) from e
+
+
+def run_game(args, game: str) -> dict:
+    """Train (unless --eval-only) then greedy-eval one game; returns the
+    CSV row. Failures are captured per game so one crash doesn't kill the
+    sweep."""
+    env_id = game_env_id(game)
+    ckpt = os.path.join(args.workdir, game, "ckpt")
+    logdir = os.path.join(args.workdir, game, "logs")
+    extra = [a for a in args.extra if a != "--"]
+    base = [
+        sys.executable, "-m", "torched_impala_tpu.run",
+        "--config", args.config, "--env-id", env_id,
+        "--checkpoint-dir", ckpt,
+    ]
+    row = {"game": game, "env_id": env_id}
+    if not args.eval_only:
+        cmd = base + [
+            "--logger", "jsonl", "--logdir", logdir, "--resume",
+        ] + (
+            ["--total-env-frames", str(args.total_env_frames)]
+            if args.total_env_frames
+            else []
+        ) + extra
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        row["train_rc"] = proc.returncode
+        if proc.returncode != 0:
+            row["error"] = proc.stderr.strip()[-300:]
+            return row
+    cmd = base + [
+        "--mode", "eval", "--eval-episodes", str(args.eval_episodes),
+    ] + extra
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    row["eval_rc"] = proc.returncode
+    m = re.search(r"mean_return=([-\d.]+)", proc.stderr + proc.stdout)
+    if m:
+        row["mean_return"] = float(m.group(1))
+    elif proc.returncode != 0:
+        row["error"] = proc.stderr.strip()[-300:]
+    return row
+
+
+def load_done_rows(path: str) -> dict:
+    """Rows from a previous sweep that already carry a mean_return —
+    these games are skipped and their rows preserved (a resumed sweep
+    must never destroy recorded results)."""
+    done = {}
+    if os.path.exists(path):
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                if row.get("mean_return"):
+                    done[row["game"]] = row
+    return done
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    require_ale()
+    games = args.games or ATARI_57
+    os.makedirs(args.workdir, exist_ok=True)
+    os.makedirs(
+        os.path.dirname(os.path.abspath(args.out)), exist_ok=True
+    )
+    done = load_done_rows(args.out)
+    fields = ["game", "env_id", "train_rc", "eval_rc", "mean_return",
+              "error"]
+    with open(args.out, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+        writer.writeheader()
+        # Re-write every preserved row up front (not interleaved): the
+        # rewrite truncates the file, so recorded results must be back on
+        # disk before any multi-hour per-game run can crash the sweep.
+        for game, row in done.items():
+            writer.writerow(row)
+        f.flush()
+        for game in games:
+            if game in done:
+                print(f"{game}: done (kept recorded row)", file=sys.stderr)
+                continue
+            row = run_game(args, game)
+            writer.writerow(row)
+            f.flush()
+            print(
+                f"{game}: return={row.get('mean_return', 'n/a')} "
+                f"{'ERROR: ' + row['error'][:80] if 'error' in row else ''}",
+                file=sys.stderr,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
