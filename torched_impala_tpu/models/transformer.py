@@ -4,15 +4,15 @@ An alternative temporal core to the LSTM (the reference's recurrence is an
 LSTM; SURVEY.md §6 notes that if a transformer policy were added, sharding
 the time axis with collective-permute ring attention is the natural TPU
 path — `parallel/ring_attention.py` and `parallel/ulysses.py` provide
-those ops with this core's full attention semantics: segment-id
-episode-boundary masking AND the sliding-window KV-cache cross-attention
-as a replicated `prefix_*` block (cache slots seg-gated, -1 = empty).
-Rotary positions are applied at projection time in this core — before
-attention — so they need nothing from the SP ops. What remains for a
-full sequence-sharded core is plumbing, not math: reshaping this core's
-`[B, T, D]` projections to the ops' `[T, B, H, Dh]` and carrying the
-window-truncation bookkeeping). This core makes long-context policies
-first-class:
+those ops with this core's full attention semantics, and this core can
+USE them: `attention="ring"|"ulysses"` with `sp_mesh=seq_mesh(n)`
+computes the same attention (same params, same outputs — pinned by
+tests/test_transformer.py) over a sequence-sharded unroll, the KV cache
+riding along as the ops' replicated segment-gated `prefix_*` block;
+rotary positions are applied at projection time, before attention.
+Learner-level use needs a combined ('data','seq') mesh — documented
+future work; the core-level path is the load-bearing piece). This core
+makes long-context policies first-class:
 
 - **unroll mode** processes the whole `[T, B]` unroll in parallel (no
   sequential scan — attention is the transformer's advantage on the MXU);
@@ -35,7 +35,7 @@ Fresh state has kv_seg = -1 (matches no real segment => empty context).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import flax.linen as nn
 import jax
@@ -68,14 +68,22 @@ def rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
 
 
 class _Block(nn.Module):
-    """Pre-LN transformer block; attention consumes explicit K/V + mask."""
+    """Pre-LN transformer block; attention consumes explicit K/V + mask.
+
+    `sp_ctx=None` computes dense attention over the pre-concatenated
+    context with the explicit mask. With `sp_ctx` (a dict from
+    TransformerCore) the SAME parameters compute the SAME attention
+    through the sequence-parallel ops: the current-token KV becomes the
+    sharded sequence, the cache becomes the replicated prefix block, and
+    the core's visibility rules map onto the ops' causal + segment +
+    prefix-segment masking exactly."""
 
     d_model: int
     num_heads: int
     mlp_factor: int = 4
 
     @nn.compact
-    def __call__(self, x, k_ctx, v_ctx, mask, q_pos):
+    def __call__(self, x, k_ctx, v_ctx, mask, q_pos, sp_ctx=None):
         """x `[B, T, D]` queries; k_ctx/v_ctx `[B, S, D]` context (cache +
         current tokens, already projected by THIS block's kv projections —
         see TransformerCore); mask `[B, T, S]` bool; q_pos `[B, T]` int32."""
@@ -85,14 +93,42 @@ class _Block(nn.Module):
         h = nn.LayerNorm(name="ln_attn")(x)
         q = nn.Dense(D, name="q_proj")(h).reshape(B, T, H, dh)
         q = rotary(q, q_pos)
-        k = k_ctx.reshape(B, -1, H, dh)  # already rotary'd at projection
-        v = v_ctx.reshape(B, -1, H, dh)
-        logits = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(float(dh))
-        logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
-        attn = jax.nn.softmax(logits, axis=-1)
-        # Fully-masked rows (empty context can't happen: self always
-        # visible) — no special case needed.
-        out = jnp.einsum("bhts,bshd->bthd", attn, v).reshape(B, T, D)
+        if sp_ctx is not None:
+            from torched_impala_tpu.parallel import (
+                ring_attention_sharded,
+                ulysses_attention_sharded,
+            )
+
+            op = {
+                "ring": ring_attention_sharded,
+                "ulysses": ulysses_attention_sharded,
+            }[sp_ctx["kind"]]
+            to_tb = lambda a: a.reshape(B, -1, H, dh).transpose(  # noqa: E731
+                1, 0, 2, 3
+            )
+            out = op(
+                q.transpose(1, 0, 2, 3),  # [T, B, H, dh]
+                to_tb(sp_ctx["k_new"]),
+                to_tb(sp_ctx["v_new"]),
+                sp_ctx["mesh"],
+                causal=True,
+                segment_ids=sp_ctx["seg_q"].transpose(1, 0),  # [T, B]
+                prefix_k=to_tb(sp_ctx["k_cache"]),  # [W, B, H, dh]
+                prefix_v=to_tb(sp_ctx["v_cache"]),
+                prefix_seg=sp_ctx["kv_seg"].transpose(1, 0),  # [W, B]
+            )
+            out = out.transpose(1, 0, 2, 3).reshape(B, T, D)
+        else:
+            k = k_ctx.reshape(B, -1, H, dh)  # rotary'd at projection
+            v = v_ctx.reshape(B, -1, H, dh)
+            logits = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(
+                float(dh)
+            )
+            logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+            attn = jax.nn.softmax(logits, axis=-1)
+            # Fully-masked rows (empty context can't happen: self always
+            # visible) — no special case needed.
+            out = jnp.einsum("bhts,bshd->bthd", attn, v).reshape(B, T, D)
         x = x + nn.Dense(D, name="o_proj")(out)
         h = nn.LayerNorm(name="ln_mlp")(x)
         h = nn.Dense(self.mlp_factor * D, name="mlp_in")(h)
@@ -114,6 +150,14 @@ class TransformerCore(nn.Module):
     num_heads: int = 4
     window: int = 128
     mlp_factor: int = 4
+    # "dense" computes attention locally; "ring"/"ulysses" compute the
+    # SAME attention (same params, same outputs) through the
+    # sequence-parallel ops over `sp_mesh` (a ('seq',) mesh): the unroll's
+    # T axis is sharded, the KV cache rides along as the replicated
+    # prefix block. The mesh axis size must divide T ("ulysses" also
+    # needs it to divide num_heads).
+    attention: str = "dense"
+    sp_mesh: Any = None
 
     def initial_state(self, batch_size: int) -> TransformerCoreState:
         B, L, W, D = batch_size, self.num_layers, self.window, self.d_model
@@ -143,15 +187,34 @@ class TransformerCore(nn.Module):
         )  # [B, T]
         pos_q = state.pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
 
-        # Visibility masks.
-        cache_vis = (seg_q[:, :, None] == state.kv_seg[:, None, :])  # [B,T,W]
-        causal = (
-            jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
-        )  # [T, T'] queries attend to earlier-or-self unroll steps
-        intra_vis = (
-            (seg_q[:, :, None] == seg_q[:, None, :]) & causal[None, :, :]
-        )  # [B, T, T]
-        mask = jnp.concatenate([cache_vis, intra_vis], axis=2)  # [B,T,W+T]
+        if self.attention not in ("dense", "ring", "ulysses"):
+            raise ValueError(
+                f"attention={self.attention!r}; expected 'dense', "
+                "'ring', or 'ulysses'"
+            )
+        sp = self.attention != "dense"
+        if sp and self.sp_mesh is None:
+            raise ValueError(
+                f"attention={self.attention!r} needs sp_mesh (a ('seq',) "
+                "mesh; parallel.seq_mesh)"
+            )
+        mask = None
+        if not sp:
+            # Visibility masks (dense path; the SP ops derive the same
+            # visibility from causal + segment + prefix-segment inputs).
+            cache_vis = (
+                seg_q[:, :, None] == state.kv_seg[:, None, :]
+            )  # [B,T,W]
+            causal = (
+                jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+            )  # [T, T'] queries attend to earlier-or-self unroll steps
+            intra_vis = (
+                (seg_q[:, :, None] == seg_q[:, None, :])
+                & causal[None, :, :]
+            )  # [B, T, T]
+            mask = jnp.concatenate(
+                [cache_vis, intra_vis], axis=2
+            )  # [B,T,W+T]
 
         new_k_layers = []
         new_v_layers = []
@@ -169,12 +232,24 @@ class TransformerCore(nn.Module):
                 [state.k_cache[:, layer], k_new], axis=1
             )  # [B, W+T, D]
             v_ctx = jnp.concatenate([state.v_cache[:, layer], v_new], axis=1)
+            sp_ctx = None
+            if sp:
+                sp_ctx = {
+                    "kind": self.attention,
+                    "mesh": self.sp_mesh,
+                    "k_new": k_new,
+                    "v_new": v_new,
+                    "k_cache": state.k_cache[:, layer],
+                    "v_cache": state.v_cache[:, layer],
+                    "seg_q": seg_q,
+                    "kv_seg": state.kv_seg,
+                }
             x = _Block(
                 d_model=D,
                 num_heads=self.num_heads,
                 mlp_factor=self.mlp_factor,
                 name=f"block_{layer}",
-            )(x, k_ctx, v_ctx, mask, pos_q)
+            )(x, k_ctx, v_ctx, mask, pos_q, sp_ctx=sp_ctx)
             new_k_layers.append(k_ctx[:, -W:])
             new_v_layers.append(v_ctx[:, -W:])
 
