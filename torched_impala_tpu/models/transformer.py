@@ -10,9 +10,13 @@ computes the same attention (same params, same outputs — pinned by
 tests/test_transformer.py) over a sequence-sharded unroll, the KV cache
 riding along as the ops' replicated segment-gated `prefix_*` block;
 rotary positions are applied at projection time, before attention.
-Learner-level use needs a combined ('data','seq') mesh — documented
-future work; the core-level path is the load-bearing piece). This core
-makes long-context policies first-class:
+Combined data+sequence parallelism works too: `sp_mesh` with
+('data','seq') axes and `sp_batch_axis="data"` shards the batch and the
+unroll simultaneously, with forward AND gradients matching the dense
+core under jit — the math a data+sequence-parallel learner runs. What
+remains for full Learner-class integration is its batcher/sharding
+plumbing over such a mesh). This core makes long-context policies
+first-class:
 
 - **unroll mode** processes the whole `[T, B]` unroll in parallel (no
   sequential scan — attention is the transformer's advantage on the MXU);
@@ -116,6 +120,7 @@ class _Block(nn.Module):
                 prefix_k=to_tb(sp_ctx["k_cache"]),  # [W, B, H, dh]
                 prefix_v=to_tb(sp_ctx["v_cache"]),
                 prefix_seg=sp_ctx["kv_seg"].transpose(1, 0),  # [W, B]
+                batch_axis=sp_ctx["batch_axis"],
             )
             out = out.transpose(1, 0, 2, 3).reshape(B, T, D)
         else:
@@ -152,12 +157,17 @@ class TransformerCore(nn.Module):
     mlp_factor: int = 4
     # "dense" computes attention locally; "ring"/"ulysses" compute the
     # SAME attention (same params, same outputs) through the
-    # sequence-parallel ops over `sp_mesh` (a ('seq',) mesh): the unroll's
-    # T axis is sharded, the KV cache rides along as the replicated
-    # prefix block. The mesh axis size must divide T ("ulysses" also
-    # needs it to divide num_heads).
+    # sequence-parallel ops over `sp_mesh` — a ('seq',) mesh, or a
+    # ('data','seq') mesh with sp_batch_axis="data" for combined DP+SP:
+    # the unroll's T axis is sharded, the KV cache rides along as the
+    # replicated prefix block. The 'seq' axis size must divide T
+    # ("ulysses" also needs it to divide num_heads).
     attention: str = "dense"
     sp_mesh: Any = None
+    # Optional second mesh axis to shard the BATCH over (combined
+    # data+sequence parallelism: sp_mesh has ('data','seq') axes, the
+    # unroll shards over 'seq' and the batch over sp_batch_axis='data').
+    sp_batch_axis: Any = None
 
     def initial_state(self, batch_size: int) -> TransformerCoreState:
         B, L, W, D = batch_size, self.num_layers, self.window, self.d_model
@@ -195,8 +205,9 @@ class TransformerCore(nn.Module):
         sp = self.attention != "dense"
         if sp and self.sp_mesh is None:
             raise ValueError(
-                f"attention={self.attention!r} needs sp_mesh (a ('seq',) "
-                "mesh; parallel.seq_mesh)"
+                f"attention={self.attention!r} needs sp_mesh — a "
+                "('seq',) mesh (parallel.seq_mesh) or a ('data','seq') "
+                "mesh with sp_batch_axis='data'"
             )
         mask = None
         if not sp:
@@ -237,6 +248,7 @@ class TransformerCore(nn.Module):
                 sp_ctx = {
                     "kind": self.attention,
                     "mesh": self.sp_mesh,
+                    "batch_axis": self.sp_batch_axis,
                     "k_new": k_new,
                     "v_new": v_new,
                     "k_cache": state.k_cache[:, layer],

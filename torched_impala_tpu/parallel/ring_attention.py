@@ -202,12 +202,14 @@ def ring_attention_sharded(
     prefix_k: jax.Array | None = None,
     prefix_v: jax.Array | None = None,
     prefix_seg: jax.Array | None = None,
+    batch_axis: str | None = None,
 ) -> jax.Array:
     """Global-view wrapper: q/k/v `[T_global, B, H, Dh]` (and optional
-    `segment_ids` `[T_global, B]`, `prefix_*` cache block — replicated,
-    see `ring_attention`); shards T over `axis_name`, runs the ring,
-    returns the global `[T_global, ...]` result. T_global must divide
-    evenly by the axis size."""
+    `segment_ids` `[T_global, B]`, `prefix_*` cache block — replicated
+    along the seq axis, see `ring_attention`); shards T over `axis_name`
+    (and B over `batch_axis` if given — the combined data+sequence
+    parallel layout), runs the ring, returns the global `[T_global, ...]`
+    result. T_global must divide evenly by the axis size."""
     return _shard_over_seq(
         ring_attention,
         mesh,
@@ -220,6 +222,7 @@ def ring_attention_sharded(
         prefix_k=prefix_k,
         prefix_v=prefix_v,
         prefix_seg=prefix_seg,
+        batch_axis=batch_axis,
     )
 
 
@@ -236,11 +239,20 @@ def _shard_over_seq(
     prefix_k=None,
     prefix_v=None,
     prefix_seg=None,
+    batch_axis=None,
 ):
     """Shared global-view wrapper for both SP ops: q/k/v (and, when
     given, segment_ids) are sharded over `axis_name`; prefix operands are
-    replicated (the cache block is whole on every device)."""
-    spec = P(axis_name)
+    replicated along it (the cache block is whole on every seq-ring).
+
+    `batch_axis` names a SECOND mesh axis to shard the batch dimension
+    over (the combined ('data','seq') layout a data+sequence-parallel
+    learner uses): every operand's B axis — q/k/v axis 1, segment_ids
+    axis 1, prefix axis 1 — shards over it, and the ops' collectives
+    still ride `axis_name` only, so each data shard runs its own
+    independent seq ring. None = batch replicated (1-d seq mesh)."""
+    spec = P(axis_name, batch_axis)
+    pre_spec = P(None, batch_axis)
     seq_args = (q, k, v) + (() if segment_ids is None else (segment_ids,))
     n_seq = len(seq_args)
     pre_args = tuple(
@@ -268,11 +280,13 @@ def _shard_over_seq(
     sharded = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec,) * n_seq + (P(),) * len(pre_args),
+        in_specs=(spec,) * n_seq + (pre_spec,) * len(pre_args),
         out_specs=spec,
     )
     put_s = lambda x: jax.device_put(x, NamedSharding(mesh, spec))  # noqa: E731
-    put_r = lambda x: jax.device_put(x, NamedSharding(mesh, P()))  # noqa: E731
+    put_r = lambda x: jax.device_put(  # noqa: E731
+        x, NamedSharding(mesh, pre_spec)
+    )
     return sharded(
         *(put_s(x) for x in seq_args), *(put_r(x) for x in pre_args)
     )

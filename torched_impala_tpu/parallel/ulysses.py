@@ -165,10 +165,12 @@ def ulysses_attention_sharded(
     prefix_k: jax.Array | None = None,
     prefix_v: jax.Array | None = None,
     prefix_seg: jax.Array | None = None,
+    batch_axis: str | None = None,
 ) -> jax.Array:
     """Global-view wrapper mirroring `ring_attention_sharded`: q/k/v
     `[T_global, B, H, Dh]` (and optional `segment_ids` `[T_global, B]`,
-    `prefix_*` cache block — replicated); shards T over `axis_name`,
+    `prefix_*` cache block — replicated along the seq axis; `batch_axis`
+    shards B over a second mesh axis); shards T over `axis_name`,
     re-shards across the attention with all-to-alls, returns the global
     result. T_global and H must divide evenly by the axis size."""
     from torched_impala_tpu.parallel.ring_attention import _shard_over_seq
@@ -185,4 +187,5 @@ def ulysses_attention_sharded(
         prefix_k=prefix_k,
         prefix_v=prefix_v,
         prefix_seg=prefix_seg,
+        batch_axis=batch_axis,
     )
