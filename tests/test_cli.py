@@ -192,6 +192,34 @@ class TestCLI:
         ])
         assert rc == 0
 
+    def test_transformer_dp_sp_through_cli(self, tmp_path):
+        """Combined data+sequence parallelism from the product surface:
+        --dp 2 --sp 4 --transformer-attention ring builds the
+        ('data','seq') mesh, the learner shards the batch over 'data',
+        and the transformer core's attention shards the unroll over
+        'seq' — full train loop on fake envs. unroll-length 7 puts the
+        learner's re-forward at T=8, divisible by the seq axis (the
+        core warns and falls back to dense otherwise)."""
+        rc = cli_main([
+            "--config", "pong_transformer",
+            "--fake-envs",
+            "--total-steps", "2",
+            "--num-actors", "2",
+            "--batch-size", "2",
+            "--unroll-length", "7",
+            "--dp", "2",
+            "--sp", "4",
+            "--transformer-attention", "ring",
+            "--log-every", "1",
+            "--logger", "jsonl",
+            "--logdir", str(tmp_path),
+        ])
+        assert rc == 0
+        lines = (
+            tmp_path / "pong_transformer.jsonl"
+        ).read_text().splitlines()
+        assert np.isfinite(json.loads(lines[-1])["total_loss"])
+
     def test_env_id_and_dispatch_overrides(self):
         """--env-id and --steps-per-dispatch reach the built config (the
         per-game override an Atari-57 sweep over one preset needs). With

@@ -62,6 +62,13 @@ class ExperimentConfig:
     transformer_layers: int = 2
     transformer_heads: int = 4
     transformer_window: int = 128
+    # "dense" | "ring" | "ulysses": route the transformer core's
+    # attention through the sequence-parallel ops (needs a ('data','seq')
+    # mesh — run.py builds one from --dp/--sp; models/transformer.py).
+    transformer_attention: str = "dense"
+    # Shard the unroll's time axis over this many devices (the 'seq' mesh
+    # axis); 0 = off. Combined with dp_devices as a ('data','seq') mesh.
+    sp_devices: int = 0
     # Atari preprocessing options (standard DeepMind stack extras).
     episodic_life: bool = False
     fire_reset: bool = False
@@ -117,7 +124,13 @@ class ExperimentConfig:
         return max(1, self.total_env_frames // self.frames_per_step)
 
 
-def make_agent(cfg: ExperimentConfig) -> Agent:
+def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
+    """Build the policy agent for a config.
+
+    `mesh` is required when `cfg.transformer_attention != "dense"`: the
+    transformer core's sequence-parallel attention runs over it (a
+    ('data','seq') mesh from `run.py --dp N --sp M`, batch over 'data',
+    unroll over 'seq'; see models/transformer.py)."""
     if cfg.compute_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"unknown compute_dtype {cfg.compute_dtype!r}; "
@@ -132,18 +145,31 @@ def make_agent(cfg: ExperimentConfig) -> Agent:
         torso = AtariDeepTorso(dtype=dtype)
     else:
         raise ValueError(f"unknown model {cfg.model!r}")
+    transformer = (
+        ("d_model", cfg.transformer_d_model),
+        ("num_layers", cfg.transformer_layers),
+        ("num_heads", cfg.transformer_heads),
+        ("window", cfg.transformer_window),
+    )
+    if cfg.transformer_attention != "dense":
+        if mesh is None:
+            raise ValueError(
+                f"transformer_attention={cfg.transformer_attention!r} "
+                "needs a ('data','seq') mesh (run.py builds one from "
+                "--dp/--sp)"
+            )
+        transformer += (
+            ("attention", cfg.transformer_attention),
+            ("sp_mesh", mesh),
+            ("sp_batch_axis", "data"),
+        )
     net = ImpalaNet(
         num_actions=cfg.num_actions,
         torso=torso,
         use_lstm=cfg.use_lstm,
         core=cfg.core,
         lstm_size=cfg.lstm_size,
-        transformer=(
-            ("d_model", cfg.transformer_d_model),
-            ("num_layers", cfg.transformer_layers),
-            ("num_heads", cfg.transformer_heads),
-            ("window", cfg.transformer_window),
-        ),
+        transformer=transformer,
         num_values=cfg.num_tasks,
     )
     return Agent(net)

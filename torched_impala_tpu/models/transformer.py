@@ -10,12 +10,15 @@ computes the same attention (same params, same outputs — pinned by
 tests/test_transformer.py) over a sequence-sharded unroll, the KV cache
 riding along as the ops' replicated segment-gated `prefix_*` block;
 rotary positions are applied at projection time, before attention.
-Combined data+sequence parallelism works too: `sp_mesh` with
+Combined data+sequence parallelism works end-to-end: `sp_mesh` with
 ('data','seq') axes and `sp_batch_axis="data"` shards the batch and the
-unroll simultaneously, with forward AND gradients matching the dense
-core under jit — the math a data+sequence-parallel learner runs. What
-remains for full Learner-class integration is its batcher/sharding
-plumbing over such a mesh). This core makes long-context policies
+unroll simultaneously, and the unmodified Learner composes with it —
+its data shardings + this core's internal seq shard_map produce the
+identical loss/params as the dense single-device learner
+(tests/test_transformer.py), reachable from the CLI via
+`--dp N --sp M --transformer-attention ring`. When T isn't shardable —
+param init, the actors' T=1 step mode — the core falls back to the
+identical-output dense path). This core makes long-context policies
 first-class:
 
 - **unroll mode** processes the whole `[T, B]` unroll in parallel (no
@@ -209,6 +212,27 @@ class TransformerCore(nn.Module):
                 "('seq',) mesh (parallel.seq_mesh) or a ('data','seq') "
                 "mesh with sp_batch_axis='data'"
             )
+        if sp:
+            # SP shards the unroll's T axis; when T isn't shardable —
+            # param init and the actors' step mode run this core at T=1 —
+            # fall back to the (identical-output) dense path. SP only
+            # pays off on long unrolls anyway. NOTE the learner re-forward
+            # runs this core at T = unroll_length + 1 (the bootstrap
+            # step), so choose unroll_length ≡ -1 (mod seq axis size).
+            n_seq = dict(self.sp_mesh.shape).get("seq", 1)
+            sp = T % n_seq == 0 and T >= n_seq > 1
+            if not sp and T > 1:
+                # A silent fallback on a long unroll means the seq devices
+                # idle while the user believes SP is on — say so (once per
+                # trace).
+                import warnings
+
+                warnings.warn(
+                    f"attention={self.attention!r} requested but T={T} "
+                    f"is not shardable over seq={n_seq} (learner T is "
+                    "unroll_length+1); running the dense path",
+                    stacklevel=2,
+                )
         mask = None
         if not sp:
             # Visibility masks (dense path; the SP ops derive the same

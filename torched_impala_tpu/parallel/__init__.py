@@ -2,6 +2,7 @@
 ring attention for long-context policies (SURVEY.md §3b, §6)."""
 
 from torched_impala_tpu.parallel.mesh import (  # noqa: F401
+    data_seq_mesh,
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
@@ -21,6 +22,7 @@ from torched_impala_tpu.parallel.ulysses import (  # noqa: F401
 )
 
 __all__ = [
+    "data_seq_mesh",
     "DATA_AXIS",
     "multihost",
     "MODEL_AXIS",

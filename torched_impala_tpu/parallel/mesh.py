@@ -57,3 +57,29 @@ def batch_sharding(mesh: Mesh, *, time_major: bool = True) -> NamedSharding:
 def state_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for `[B, ...]` recurrent-state leaves: batch over `data`."""
     return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def data_seq_mesh(
+    num_data: int,
+    num_seq: int,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A ('data','seq') mesh for combined data+sequence parallelism: the
+    learner's batch shards over 'data', the transformer core's unroll
+    attention over 'seq' (models/transformer.py sp_mesh)."""
+    if num_data < 1 or num_seq < 1:
+        raise ValueError(
+            f"num_data={num_data}, num_seq={num_seq}: both must be >= 1"
+        )
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_data * num_seq
+    if len(devices) < need:
+        raise ValueError(
+            f"data={num_data} x seq={num_seq} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    return Mesh(
+        np.asarray(devices[:need]).reshape(num_data, num_seq),
+        ("data", "seq"),
+    )

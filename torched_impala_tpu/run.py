@@ -54,6 +54,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Parallelism.
     p.add_argument("--dp", type=int, default=None,
                    help="shard learner batch over N devices (-1 = all)")
+    p.add_argument("--sp", type=int, default=None,
+                   help="shard the transformer unroll's time axis over N "
+                        "devices (('data','seq') mesh with --dp; needs "
+                        "--transformer-attention ring|ulysses; the "
+                        "learner forwards unroll_length+1 steps, so pick "
+                        "unroll-length = k*N - 1)")
+    p.add_argument("--transformer-attention",
+                   choices=("dense", "ring", "ulysses"), default=None,
+                   help="route the transformer core's attention through "
+                        "the sequence-parallel ops")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator host:port "
                         "(jax.distributed); every host runs this same "
@@ -116,6 +126,8 @@ def build_config(args: argparse.Namespace):
         ("total_env_frames", "total_env_frames"),
         ("lr", "lr"),
         ("dp", "dp_devices"),
+        ("sp", "sp_devices"),
+        ("transformer_attention", "transformer_attention"),
         ("env_id", "env_id"),
     ):
         v = getattr(args, flag)
@@ -171,12 +183,45 @@ def main(argv=None) -> int:
     from torched_impala_tpu.utils.checkpoint import Checkpointer
 
     cfg = build_config(args)
-    agent = configs.make_agent(cfg)
+
+    # The SP flags only make sense together: ring/ulysses attention with
+    # no seq axis silently runs dense, and a seq axis with dense
+    # attention reserves devices that never do anything — reject both.
+    if (cfg.transformer_attention != "dense") != bool(cfg.sp_devices):
+        raise SystemExit(
+            "--transformer-attention ring|ulysses and --sp N go together "
+            f"(got attention={cfg.transformer_attention!r}, "
+            f"sp={cfg.sp_devices})"
+        )
+    if cfg.sp_devices and cfg.core != "transformer":
+        raise SystemExit(
+            "--sp shards the transformer core's unroll attention; the "
+            f"config's core is {cfg.core!r}"
+        )
 
     mesh = None
-    if cfg.dp_devices:  # 0 = single-device; -1 = all devices; N = N devices
+    if cfg.sp_devices:
+        # Combined data+sequence parallelism: ('data','seq') mesh; the
+        # learner shards the batch over 'data' (its existing shardings),
+        # the transformer core's attention shards the unroll over 'seq'.
+        from torched_impala_tpu.parallel import data_seq_mesh
+
+        if cfg.sp_devices < 2:
+            raise SystemExit(f"--sp must be >= 2, got {cfg.sp_devices}")
+        dp = (
+            max(1, len(jax.devices()) // cfg.sp_devices)
+            if cfg.dp_devices == -1
+            else max(1, cfg.dp_devices)
+        )
+        try:
+            mesh = data_seq_mesh(dp, cfg.sp_devices)
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
+    elif cfg.dp_devices:  # 0 = single-device; -1 = all; N = N devices
         n = len(jax.devices()) if cfg.dp_devices == -1 else cfg.dp_devices
         mesh = make_mesh(num_data=n)
+
+    agent = configs.make_agent(cfg, mesh=mesh)
 
     checkpointer = (
         Checkpointer(args.checkpoint_dir)
