@@ -220,6 +220,29 @@ class TestCLI:
         ).read_text().splitlines()
         assert np.isfinite(json.loads(lines[-1])["total_loss"])
 
+    def test_transformer_dp_sp_eval_roundtrip(self, tmp_path):
+        """Checkpoint from a DP+SP training run restores into eval mode
+        (actors/eval step the core at T=1 — the dense fallback — so the
+        same agent serves both sides)."""
+        ck = str(tmp_path / "ck")
+        base = [
+            "--config", "pong_transformer",
+            "--fake-envs",
+            "--num-actors", "2",
+            "--batch-size", "2",
+            "--unroll-length", "7",
+            "--dp", "2",
+            "--sp", "4",
+            "--transformer-attention", "ring",
+            "--logger", "null",
+            "--checkpoint-dir", ck,
+        ]
+        assert cli_main(base + ["--total-steps", "1"]) == 0
+        assert cli_main(base + [
+            "--mode", "eval", "--eval-episodes", "1",
+            "--eval-max-steps", "50",
+        ]) == 0
+
     def test_env_id_and_dispatch_overrides(self):
         """--env-id and --steps-per-dispatch reach the built config (the
         per-game override an Atari-57 sweep over one preset needs). With

@@ -272,6 +272,31 @@ class TestChaosTraining:
                 max_actor_restarts=1,
             )
 
+    def test_chaos_with_fused_dispatch_stays_live(self):
+        """Fault injection composed with fused dispatch: actors crashing
+        mid-run must not stall superbatch assembly — the supervisor
+        restarts them and the learner still completes its K-step
+        dispatches."""
+        result = train(
+            agent=_small_agent(),
+            env_factory=lambda seed, env_index=None: CrashingEnv(
+                FakeDiscreteEnv(obs_shape=(6,), num_actions=3, seed=seed),
+                crash_after=25,
+            ),
+            example_obs=np.zeros((6,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(
+                batch_size=2, unroll_length=4, steps_per_dispatch=2
+            ),
+            optimizer=optax.sgd(1e-3),
+            total_steps=6,
+            seed=0,
+            log_every=1,
+            max_actor_restarts=50,
+        )
+        assert result.learner.num_steps == 6  # 3 dispatches x K=2
+        assert np.isfinite(result.final_logs.get("total_loss", np.nan))
+
 
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])
