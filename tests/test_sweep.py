@@ -1,0 +1,166 @@
+"""Atari-57 sweep driver end-to-end on fake envs (VERDICT r2 item 5).
+
+The sweep pipeline — per-game train -> checkpoint -> greedy eval ->
+resumable CSV — was previously only runnable on an ALE-equipped host;
+`--fake-envs` makes the whole driver dry-runnable here. These tests run
+the REAL driver: real run.py subprocesses, real checkpoints, real CSV
+resume semantics, with tiny budgets. Also pins the ADVICE r2 fixes:
+missing-checkpoint eval records an error row (not a random-policy
+return), CSV rewrite is atomic, nan returns parse.
+"""
+
+import csv
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torched_impala_tpu import sweep
+
+# Tiny budgets: each game trains 2 learner steps of 1 actor x 1 fake env
+# and evals 1 episode. Extra flags ride through the sweep's passthrough,
+# exactly as a user would size a smoke sweep.
+TINY = [
+    "--num-actors", "1", "--envs-per-actor", "1",
+    "--batch-size", "2", "--unroll-length", "5",
+    "--total-steps", "2", "--eval-max-steps", "64",
+    "--logger", "null", "--platform", "cpu",
+]
+
+
+def read_rows(path):
+    with open(path, newline="") as f:
+        return {r["game"]: r for r in csv.DictReader(f)}
+
+
+@pytest.mark.slow
+class TestSweepFakeEnvs:
+    def test_two_game_sweep_records_returns_and_resumes(self, tmp_path):
+        """Two fake games sweep train->checkpoint->eval->CSV; a resumed
+        sweep skips both without touching their rows."""
+        out = tmp_path / "sweep.csv"
+        rc = sweep.main([
+            "--config", "pong", "--fake-envs",
+            "--games", "Pong", "Breakout",
+            "--out", str(out), "--workdir", str(tmp_path / "runs"),
+            "--eval-episodes", "1", "--",
+        ] + TINY)
+        assert rc == 0
+        rows = read_rows(out)
+        assert set(rows) == {"Pong", "Breakout"}
+        for game, row in rows.items():
+            assert row["train_rc"] == "0", row
+            assert row["eval_rc"] == "0", row
+            assert row["mean_return"] != "", row
+            float(row["mean_return"])  # parses
+            # The per-game checkpoint really exists (eval used it).
+            assert os.path.isdir(tmp_path / "runs" / game / "ckpt")
+        before = out.read_text()
+        # Resume: both games already carry mean_return -> skipped, rows
+        # preserved byte-for-byte (order may differ; compare as dicts).
+        rc = sweep.main([
+            "--config", "pong", "--fake-envs",
+            "--games", "Pong", "Breakout",
+            "--out", str(out), "--workdir", str(tmp_path / "runs"),
+            "--",
+        ] + TINY)
+        assert rc == 0
+        assert read_rows(out) == read_rows_text(before)
+
+    def test_eval_only_without_checkpoint_records_error_row(self, tmp_path):
+        """--eval-only on a game with no checkpoint must record an error
+        row, never a random-policy mean_return (ADVICE r2): the game stays
+        re-runnable on the next resume."""
+        out = tmp_path / "sweep.csv"
+        rc = sweep.main([
+            "--config", "pong", "--fake-envs", "--eval-only",
+            "--games", "Pong",
+            "--out", str(out), "--workdir", str(tmp_path / "runs"),
+            "--eval-episodes", "1", "--",
+        ] + TINY)
+        assert rc == 0
+        row = read_rows(out)["Pong"]
+        assert row["mean_return"] == ""
+        assert row["eval_rc"] not in ("", "0")
+        assert "checkpoint" in row["error"]
+        done, diag = sweep.load_prior_rows(str(out))
+        assert done == {}  # still pending -> re-run next sweep
+        assert "Pong" in diag
+
+
+def read_rows_text(text):
+    return {r["game"]: r for r in csv.DictReader(text.splitlines())}
+
+
+class TestSweepBookkeeping:
+    """Pure CSV/parse semantics — no subprocesses."""
+
+    def test_rewrite_is_atomic_and_preserves_untouched_diag_rows(
+        self, tmp_path, monkeypatch
+    ):
+        out = tmp_path / "sweep.csv"
+        out.write_text(
+            "game,env_id,train_rc,eval_rc,mean_return,error\n"
+            "Pong,PongNoFrameskip-v4,0,0,19.5,\n"
+            "Breakout,BreakoutNoFrameskip-v4,1,,,boom\n"
+            "Alien,AlienNoFrameskip-v4,1,,,crash\n"
+        )
+        # Sweep over Pong (done -> skipped) and Breakout (error -> re-run);
+        # Alien is NOT in this invocation -> its diagnostic row survives.
+        calls = []
+
+        def fake_run_game(args, game):
+            calls.append(game)
+            return {"game": game, "env_id": sweep.game_env_id(game),
+                    "train_rc": 0, "eval_rc": 0, "mean_return": 3.0}
+
+        monkeypatch.setattr(sweep, "run_game", fake_run_game)
+        monkeypatch.setattr(sweep, "require_ale", lambda: None)
+        rc = sweep.main([
+            "--games", "Pong", "Breakout",
+            "--out", str(out), "--workdir", str(tmp_path / "runs"),
+        ])
+        assert rc == 0
+        assert calls == ["Breakout"]
+        rows = read_rows(out)
+        assert float(rows["Pong"]["mean_return"]) == 19.5  # preserved
+        assert float(rows["Breakout"]["mean_return"]) == 3.0  # re-ran
+        assert rows["Alien"]["error"] == "crash"  # untouched diag kept
+        assert not os.path.exists(str(out) + ".tmp")  # replace completed
+
+    def test_parse_mean_return_handles_nan_inf_and_junk(self):
+        assert sweep.parse_mean_return("eval: mean_return=19.50 x") == 19.5
+        assert sweep.parse_mean_return("mean_return=-3.25") == -3.25
+        import math
+
+        assert math.isnan(sweep.parse_mean_return("mean_return=nan"))
+        assert math.isinf(sweep.parse_mean_return("mean_return=inf"))
+        assert math.isinf(sweep.parse_mean_return("mean_return=-inf"))
+        assert sweep.parse_mean_return("no return here") is None
+        assert sweep.parse_mean_return("mean_return=oops") is None
+
+    def test_fake_envs_skips_ale_gate(self, tmp_path, monkeypatch):
+        """--fake-envs must not demand ale-py (the whole point is an
+        emulator-less dry run); without it the gate still fires. The gate
+        itself is stubbed so the test is host-independent (an ALE-equipped
+        host would otherwise sail through require_ale)."""
+        monkeypatch.setattr(
+            sweep, "run_game",
+            lambda args, game: {"game": game, "env_id": "x",
+                                "mean_return": 1.0},
+        )
+
+        def gate():
+            raise SystemExit("the Atari-57 sweep needs ale-py")
+
+        monkeypatch.setattr(sweep, "require_ale", gate)
+        out = tmp_path / "s.csv"
+        rc = sweep.main([
+            "--fake-envs", "--games", "Pong", "--out", str(out),
+            "--workdir", str(tmp_path / "w"),
+        ])
+        assert rc == 0
+        with pytest.raises(SystemExit, match="ale-py"):
+            sweep.main(["--games", "Pong", "--out", str(out),
+                        "--workdir", str(tmp_path / "w")])

@@ -189,12 +189,15 @@ def popart_impala_loss(
     popart_config: PopArtConfig,
     config: ImpalaLossConfig = ImpalaLossConfig(),
     mask: jax.Array | None = None,
+    devices=None,
 ) -> tuple[LossOutput, PopArtState]:
     """IMPALA loss with PopArt normalization; returns the updated stats.
 
     The caller must, after the optimizer step, apply `rescale_params` with
     the same (old state, new state) pair so the network's unnormalized
-    outputs stay continuous across the stats move.
+    outputs stay continuous across the stats move. `devices` resolves
+    `config.vtrace_implementation == 'auto'` against the devices this loss
+    actually runs on (see `losses.impala_loss`).
     """
     if mask is None:
         mask = jnp.ones_like(rewards)
@@ -220,6 +223,7 @@ def popart_impala_loss(
         clip_pg_rho_threshold=config.clip_pg_rho_threshold,
         lambda_=config.lambda_,
         implementation=config.vtrace_implementation,
+        devices=devices,
     )
 
     new_state = jax.lax.stop_gradient(

@@ -163,6 +163,7 @@ def vtrace(
     clip_pg_rho_threshold: float = 1.0,
     lambda_: float = 1.0,
     implementation: str = "auto",
+    devices=None,
 ) -> VTraceOutput:
     """V-trace with a selectable backend: 'auto', 'scan' (XLA), or 'pallas'
     (TPU kernel).
@@ -171,12 +172,14 @@ def vtrace(
     (ratio clipping, delta computation, reverse scan, pg advantage) into one
     VMEM-resident kernel. See `vtrace_pallas.py`.
 
-    'auto' here is a trace-time fallback keyed off the DEFAULT backend's
-    device platform. Callers that know their actual compute devices should
-    resolve 'auto' themselves (runtime.Learner does, so a CPU mesh built in
-    a TPU-default process still gets the scan). Measured on a real v5e chip
-    (bench.py `vtrace_pallas_vs_scan`, 2026-07-29): pallas 2.81x faster at
-    Pong shapes (T=20, B=256) and 1.27x at DMLab shapes (T=100, B=32).
+    'auto' resolves against `devices` — pass the devices this computation
+    will actually run on (e.g. `mesh.devices.flat`); runtime.Learner and
+    AnakinRunner do, so a CPU mesh built in a TPU-default process still
+    gets the scan. `devices=None` falls back to the default backend's
+    devices (correct for un-meshed callers only). Measured on a real v5e
+    chip (bench.py `vtrace_pallas_vs_scan`, 2026-07-29): pallas 2.81x
+    faster at Pong shapes (T=20, B=256) and 1.27x at DMLab shapes
+    (T=100, B=32).
     """
     kwargs = dict(
         log_rhos=log_rhos,
@@ -189,7 +192,7 @@ def vtrace(
         clip_pg_rho_threshold=clip_pg_rho_threshold,
         lambda_=lambda_,
     )
-    implementation = resolve_implementation(implementation)
+    implementation = resolve_implementation(implementation, devices)
     if implementation == "scan":
         return vtrace_scan(**kwargs)
     if implementation == "pallas":

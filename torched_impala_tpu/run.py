@@ -198,6 +198,18 @@ def main(argv=None) -> int:
             "--sp shards the transformer core's unroll attention; the "
             f"config's core is {cfg.core!r}"
         )
+    if cfg.sp_devices and (cfg.unroll_length + 1) % cfg.sp_devices != 0:
+        # Without this the core only WARNS at trace time and silently runs
+        # dense attention on an N-times larger mesh whose seq devices
+        # duplicate work (ADVICE r2). The learner forwards T+1 steps, so
+        # the shardable length is unroll_length + 1.
+        raise SystemExit(
+            f"--sp {cfg.sp_devices} needs (unroll_length+1) divisible by "
+            f"it; got unroll_length={cfg.unroll_length} "
+            f"({cfg.unroll_length + 1} % {cfg.sp_devices} = "
+            f"{(cfg.unroll_length + 1) % cfg.sp_devices}). "
+            f"Pick unroll-length = k*{cfg.sp_devices} - 1."
+        )
 
     mesh = None
     if cfg.sp_devices:
@@ -449,8 +461,17 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
             target["popart_state"] = popart_ops.init(cfg.num_tasks)
         restored = checkpointer.restore(target)
         if restored is None:
-            print("no checkpoint found; evaluating fresh params",
-                  file=sys.stderr)
+            # Distinct nonzero rc: an explicitly requested checkpoint that
+            # does not exist must not be silently replaced by fresh params
+            # — a sweep would record the random policy's return as the
+            # game's result forever (ADVICE r2). Evaluating fresh params
+            # is still available by omitting --checkpoint-dir.
+            print(
+                f"error: --checkpoint-dir {args.checkpoint_dir} holds no "
+                "checkpoint (omit the flag to eval fresh params)",
+                file=sys.stderr,
+            )
+            return 4
         else:
             params = restored["params"]
             print(

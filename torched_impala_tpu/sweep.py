@@ -18,7 +18,10 @@ sequentially (one TPU client at a time); a sweep is resumable at two
 levels — games already holding a `mean_return` row in `--out` are
 skipped entirely (their rows are preserved), and a partially-trained
 game picks its checkpoint back up via run.py `--resume`. Requires
-ale-py (gated with a clear error, like envs/factory.py).
+ale-py (gated with a clear error, like envs/factory.py) unless
+`--fake-envs` substitutes shape-faithful fakes — which makes the whole
+train->checkpoint->eval->CSV pipeline dry-runnable on an emulator-less
+host (ADVICE r2 / VERDICT r2 item 5).
 """
 
 from __future__ import annotations
@@ -63,6 +66,9 @@ def parse_args(argv=None):
     p.add_argument("--eval-episodes", type=int, default=30)
     p.add_argument("--eval-only", action="store_true",
                    help="skip training; eval existing checkpoints")
+    p.add_argument("--fake-envs", action="store_true",
+                   help="shape-faithful fake envs instead of ALE (dry-run "
+                        "the sweep pipeline on an emulator-less host)")
     p.add_argument("extra", nargs=argparse.REMAINDER,
                    help="flags after '--' pass through to run.py")
     return p.parse_args(argv)
@@ -90,7 +96,7 @@ def run_game(args, game: str) -> dict:
         sys.executable, "-m", "torched_impala_tpu.run",
         "--config", args.config, "--env-id", env_id,
         "--checkpoint-dir", ckpt,
-    ]
+    ] + (["--fake-envs"] if args.fake_envs else [])
     row = {"game": game, "env_id": env_id}
     if not args.eval_only:
         cmd = base + [
@@ -110,59 +116,103 @@ def run_game(args, game: str) -> dict:
     ] + extra
     proc = subprocess.run(cmd, capture_output=True, text=True)
     row["eval_rc"] = proc.returncode
-    m = re.search(r"mean_return=([-\d.]+)", proc.stderr + proc.stdout)
-    if m:
-        row["mean_return"] = float(m.group(1))
-    elif proc.returncode != 0:
-        row["error"] = proc.stderr.strip()[-300:]
+    # mean_return is only RECORDED (and the game thereby marked done) on a
+    # clean eval of a real checkpoint: run.py exits nonzero when
+    # --checkpoint-dir holds no checkpoint, so a missing/corrupt checkpoint
+    # can never freeze a random-policy return into the results (ADVICE r2).
+    val = parse_mean_return(proc.stderr + proc.stdout)
+    if proc.returncode == 0 and val is not None:
+        row["mean_return"] = val
+    else:
+        row["error"] = (
+            proc.stderr.strip()[-300:] or "eval output had no mean_return"
+        )
     return row
 
 
-def load_done_rows(path: str) -> dict:
-    """Rows from a previous sweep that already carry a mean_return —
-    these games are skipped and their rows preserved (a resumed sweep
-    must never destroy recorded results)."""
-    done = {}
+def parse_mean_return(text: str):
+    """Extract eval's mean_return, including nan/inf spellings (a plain
+    [-\\d.]+ pattern silently skips them and the game re-runs forever —
+    ADVICE r2). Returns None when absent/unparsable."""
+    m = re.search(r"mean_return=([-+.\w]+)", text)
+    if not m:
+        return None
+    try:
+        return float(m.group(1))
+    except ValueError:
+        return None
+
+
+def load_prior_rows(path: str) -> tuple[dict, dict]:
+    """(done, diagnostics) from a previous sweep: `done` rows carry a
+    mean_return — their games are skipped and the rows preserved (a
+    resumed sweep must never destroy recorded results). `diagnostics`
+    rows (train_rc/error, no return) are preserved for games this
+    invocation won't touch; games being re-run get a fresh row instead."""
+    done, diag = {}, {}
     if os.path.exists(path):
         with open(path, newline="") as f:
             for row in csv.DictReader(f):
                 if row.get("mean_return"):
                     done[row["game"]] = row
-    return done
+                else:
+                    diag[row["game"]] = row
+    return done, diag
+
+
+def load_done_rows(path: str) -> dict:
+    return load_prior_rows(path)[0]
+
+
+FIELDS = ["game", "env_id", "train_rc", "eval_rc", "mean_return", "error"]
+
+
+def rewrite_results(path: str, rows) -> None:
+    """Atomically replace the results CSV: the new content lands under a
+    temp name and os.replace()s the old file, so no crash window ever
+    leaves recorded results truncated (ADVICE r2)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=FIELDS, extrasaction="ignore")
+        writer.writeheader()
+        for row in rows:
+            writer.writerow(row)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    require_ale()
+    if not args.fake_envs:
+        require_ale()
     games = args.games or ATARI_57
     os.makedirs(args.workdir, exist_ok=True)
     os.makedirs(
         os.path.dirname(os.path.abspath(args.out)), exist_ok=True
     )
-    done = load_done_rows(args.out)
-    fields = ["game", "env_id", "train_rc", "eval_rc", "mean_return",
-              "error"]
-    with open(args.out, "w", newline="") as f:
-        writer = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
-        writer.writeheader()
-        # Re-write every preserved row up front (not interleaved): the
-        # rewrite truncates the file, so recorded results must be back on
-        # disk before any multi-hour per-game run can crash the sweep.
-        for game, row in done.items():
-            writer.writerow(row)
-        f.flush()
-        for game in games:
-            if game in done:
-                print(f"{game}: done (kept recorded row)", file=sys.stderr)
-                continue
-            row = run_game(args, game)
-            writer.writerow(row)
-            f.flush()
-            print(
-                f"{game}: return={row.get('mean_return', 'n/a')} "
-                f"{'ERROR: ' + row['error'][:80] if 'error' in row else ''}",
-                file=sys.stderr,
-            )
+    done, diag = load_prior_rows(args.out)
+    # One full atomic rewrite after every game: the on-disk CSV is always
+    # a complete, consistent snapshot (done rows + every game's freshest
+    # diagnostic), so neither a crash nor a Ctrl-C can truncate recorded
+    # results or lose the failure record of games not yet re-reached.
+    rows = dict(done)
+    for g, r in diag.items():
+        rows.setdefault(g, r)
+    if os.path.exists(args.out) or rows:
+        rewrite_results(args.out, rows.values())
+    for game in games:
+        if game in done:
+            print(f"{game}: done (kept recorded row)", file=sys.stderr)
+            continue
+        row = run_game(args, game)
+        rows[game] = row
+        rewrite_results(args.out, rows.values())
+        print(
+            f"{game}: return={row.get('mean_return', 'n/a')} "
+            f"{'ERROR: ' + row['error'][:80] if 'error' in row else ''}",
+            file=sys.stderr,
+        )
     return 0
 
 
