@@ -145,11 +145,24 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
         torso = AtariDeepTorso(dtype=dtype)
     else:
         raise ValueError(f"unknown model {cfg.model!r}")
+    # Dense-path attention math: the fused Pallas kernel on TPU devices,
+    # the einsum elsewhere — resolved HERE against the actual compute
+    # devices (mesh when given, default backend otherwise), mirroring the
+    # learner's V-trace 'auto' resolution; the core itself refuses 'auto'.
+    from torched_impala_tpu.ops.vtrace import resolve_implementation
+
+    devices = None if mesh is None else list(mesh.devices.flat)
+    dense_kernel = (
+        "pallas"
+        if resolve_implementation("auto", devices) == "pallas"
+        else "einsum"
+    )
     transformer = (
         ("d_model", cfg.transformer_d_model),
         ("num_layers", cfg.transformer_layers),
         ("num_heads", cfg.transformer_heads),
         ("window", cfg.transformer_window),
+        ("dense_kernel", dense_kernel),
     )
     if cfg.transformer_attention != "dense":
         if mesh is None:

@@ -90,10 +90,18 @@ class _Block(nn.Module):
     mlp_factor: int = 4
 
     @nn.compact
-    def __call__(self, x, k_ctx, v_ctx, mask, q_pos, sp_ctx=None):
+    def __call__(
+        self, x, k_ctx, v_ctx, mask, q_pos, sp_ctx=None, pallas_ctx=None
+    ):
         """x `[B, T, D]` queries; k_ctx/v_ctx `[B, S, D]` context (cache +
         current tokens, already projected by THIS block's kv projections —
-        see TransformerCore); mask `[B, T, S]` bool; q_pos `[B, T]` int32."""
+        see TransformerCore); mask `[B, T, S]` bool; q_pos `[B, T]` int32.
+
+        `pallas_ctx` (dict with seg_q `[B, T]`, seg_ctx `[B, S]`, W,
+        interpret) routes the dense path through the fused Pallas kernel
+        (ops/attention_pallas.py) — same parameters, same outputs, the
+        mask derived in-kernel from the segment ids instead of being
+        materialized."""
         B, T, D = x.shape
         H = self.num_heads
         dh = D // H
@@ -126,6 +134,20 @@ class _Block(nn.Module):
                 batch_axis=sp_ctx["batch_axis"],
             )
             out = out.transpose(1, 0, 2, 3).reshape(B, T, D)
+        elif pallas_ctx is not None:
+            from torched_impala_tpu.ops.attention_pallas import (
+                windowed_attention,
+            )
+
+            out = windowed_attention(
+                q,
+                k_ctx.reshape(B, -1, H, dh),
+                v_ctx.reshape(B, -1, H, dh),
+                pallas_ctx["seg_q"],
+                pallas_ctx["seg_ctx"],
+                pallas_ctx["W"],
+                pallas_ctx["interpret"],
+            ).reshape(B, T, D)
         else:
             k = k_ctx.reshape(B, -1, H, dh)  # rotary'd at projection
             v = v_ctx.reshape(B, -1, H, dh)
@@ -171,6 +193,14 @@ class TransformerCore(nn.Module):
     # data+sequence parallelism: sp_mesh has ('data','seq') axes, the
     # unroll shards over 'seq' and the batch over sp_batch_axis='data').
     sp_batch_axis: Any = None
+    # Dense-path attention math: "einsum" (XLA) or "pallas" (fused TPU
+    # kernel, ops/attention_pallas.py — same params, same outputs, pinned
+    # by tests/test_attention_pallas.py). Resolve 'auto' in the CALLER
+    # against the actual compute devices (configs.make_agent does, like
+    # the learner's V-trace resolution) — the core only accepts the two
+    # concrete values. Step mode (T=1) always uses einsum: one cached-
+    # attention step is too small to pay a kernel launch for.
+    dense_kernel: str = "einsum"
 
     def initial_state(self, batch_size: int) -> TransformerCoreState:
         B, L, W, D = batch_size, self.num_layers, self.window, self.d_model
@@ -233,8 +263,31 @@ class TransformerCore(nn.Module):
                     "unroll_length+1); running the dense path",
                     stacklevel=2,
                 )
+        if self.dense_kernel not in ("einsum", "pallas"):
+            raise ValueError(
+                f"dense_kernel={self.dense_kernel!r}; expected 'einsum' or "
+                "'pallas' ('auto' must be resolved by the caller against "
+                "its compute devices)"
+            )
+        use_pallas = self.dense_kernel == "pallas" and not sp and T > 1
+        pallas_ctx = None
+        if use_pallas:
+            # Loop-invariant (every layer sees the same segments/window),
+            # so build it once like the einsum mask below.
+            from torched_impala_tpu.ops.vtrace import (
+                _default_backend_is_tpu,
+            )
+
+            pallas_ctx = {
+                "seg_q": seg_q,
+                "seg_ctx": jnp.concatenate([state.kv_seg, seg_q], axis=1),
+                "W": W,
+                # Interpreter mode off-TPU so CPU tests/meshes run the
+                # same code path (mirrors vtrace_pallas).
+                "interpret": not _default_backend_is_tpu(),
+            }
         mask = None
-        if not sp:
+        if not sp and not use_pallas:
             # Visibility masks (dense path; the SP ops derive the same
             # visibility from causal + segment + prefix-segment inputs).
             cache_vis = (
@@ -285,7 +338,8 @@ class TransformerCore(nn.Module):
                 num_heads=self.num_heads,
                 mlp_factor=self.mlp_factor,
                 name=f"block_{layer}",
-            )(x, k_ctx, v_ctx, mask, pos_q, sp_ctx=sp_ctx)
+            )(x, k_ctx, v_ctx, mask, pos_q, sp_ctx=sp_ctx,
+              pallas_ctx=pallas_ctx)
             new_k_layers.append(k_ctx[:, -W:])
             new_v_layers.append(v_ctx[:, -W:])
 

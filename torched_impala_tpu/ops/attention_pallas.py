@@ -1,0 +1,214 @@
+"""Pallas TPU kernel for the transformer core's dense attention path.
+
+Fuses the whole masked-attention forward — QK^T, the cache/causal/segment
+visibility mask, the stable softmax, and the PV contraction — into one
+VMEM-resident kernel per (batch row, head, query block), so the
+`[B, H, T, S]` logits/probs tensors never materialize in HBM (the einsum
+path in models/transformer.py writes both). Visibility is derived
+IN-KERNEL from segment ids rather than streamed as a precomputed mask:
+
+    visible(t, s) = (seg_ctx[s] == seg_q[t])           # same episode
+                    and (s < W  or  s - W <= t)        # cache slot, or
+                                                       # causal in-unroll
+
+which is exactly the dense path's `concat(cache_vis, intra_vis)` mask
+(pinned by tests/test_attention_pallas.py against the einsum reference).
+
+Gradients: attention sits in the learner's loss path, so the op carries a
+custom VJP. The backward pass RECOMPUTES probabilities from the saved
+q/k/v (flash-attention's standard rematerialization trade: ~1 extra
+matmul instead of storing `[B, H, T, S]` probs between passes) and runs
+the classic softmax-attention backward in plain XLA einsums.
+
+Used by models/transformer.py when `dense_kernel="pallas"` (resolved from
+'auto' against the compute devices in configs.make_agent, like the
+V-trace kernel). The sequence-parallel ring/Ulysses paths are orthogonal:
+they shard S across devices; this kernel accelerates the single-device
+dense math. Capability parity: the reference's CUDA fused attention is
+the analog surface (SURVEY.md §6 long-context row; reconstructed — the
+reference mount is empty, SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_PAD_SEG = -2_147_483_000  # matches no real segment id (kv empty is -1)
+
+
+def _attn_kernel(
+    q_ref,  # [1, Tb, 1, dh]
+    k_ref,  # [1, S, 1, dh]
+    v_ref,  # [1, S, 1, dh]
+    segq_ref,  # [1, Tb] int32
+    segc_ref,  # [1, S] int32
+    o_ref,  # [1, Tb, 1, dh]
+    *,
+    scale: float,
+    W: int,
+    Tb: int,
+    S: int,
+):
+    q = q_ref[0, :, 0, :]  # [Tb, dh]
+    k = k_ref[0, :, 0, :]  # [S, dh]
+    v = v_ref[0, :, 0, :]
+    seg_q = segq_ref[0, :]  # [Tb]
+    seg_c = segc_ref[0, :]  # [S]
+
+    logits = (
+        jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [Tb, S]
+
+    tq = pl.program_id(2) * Tb + jax.lax.broadcasted_iota(
+        jnp.int32, (Tb, S), 0
+    )  # absolute in-unroll query index
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (Tb, S), 1)
+    visible = (seg_q[:, None] == seg_c[None, :]) & (
+        (s_idx < W) | (s_idx - W <= tq)
+    )
+    logits = jnp.where(visible, logits, NEG_INF)
+
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0, :, 0, :] = jax.lax.dot_general(
+        p.astype(v.dtype),
+        v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
+    B, T, H, dh = q.shape
+    S = k_ctx.shape[1]
+    f32 = jnp.float32
+    out_dtype = q.dtype  # preserve input dtype like the einsum path
+    q, k_ctx, v_ctx = (jnp.asarray(x, f32) for x in (q, k_ctx, v_ctx))
+
+    # Pad T and S to TPU-friendly tiles. Padded context slots carry a
+    # sentinel segment (visible to nothing => zero weight after softmax);
+    # padded query rows compute garbage and are sliced off (NEG_INF is
+    # finite, so even an all-masked row softmaxes without NaN).
+    Tb = min(128, _round_up(T, 8))
+    Tp = _round_up(T, Tb)
+    Sp = _round_up(S, 128)
+    qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+    kp = jnp.pad(k_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    vp = jnp.pad(v_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    segq_p = jnp.pad(
+        seg_q.astype(jnp.int32),
+        ((0, 0), (0, Tp - T)),
+        constant_values=_PAD_SEG + 1,
+    )
+    segc_p = jnp.pad(
+        seg_ctx.astype(jnp.int32),
+        ((0, 0), (0, Sp - S)),
+        constant_values=_PAD_SEG,
+    )
+
+    kernel = functools.partial(
+        _attn_kernel, scale=1.0 / (dh**0.5), W=W, Tb=Tb, S=Sp
+    )
+    qo_spec = pl.BlockSpec(
+        (1, Tb, 1, dh), lambda b, h, t: (b, t, h, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, Sp, 1, dh), lambda b, h, t: (b, 0, h, 0), memory_space=pltpu.VMEM
+    )
+    segq_spec = pl.BlockSpec(
+        (1, Tb), lambda b, h, t: (b, t), memory_space=pltpu.VMEM
+    )
+    segc_spec = pl.BlockSpec(
+        (1, Sp), lambda b, h, t: (b, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, Tp // Tb),
+        in_specs=[qo_spec, kv_spec, kv_spec, segq_spec, segc_spec],
+        out_specs=qo_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Tp, H, dh), f32),
+        interpret=interpret,
+    )(qp, kp, vp, segq_p, segc_p)
+    return out[:, :T].astype(out_dtype)
+
+
+def _visibility(seg_q, seg_ctx, T: int, S: int, W: int):
+    """The einsum path's mask, recomputed for the backward pass."""
+    t = jnp.arange(T, dtype=jnp.int32)
+    s = jnp.arange(S, dtype=jnp.int32)
+    pos_ok = (s[None, :] < W) | (s[None, :] - W <= t[:, None])  # [T, S]
+    return (
+        seg_q[:, :, None] == seg_ctx[:, None, :]
+    ) & pos_ok[None, :, :]  # [B, T, S]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def windowed_attention(q, k_ctx, v_ctx, seg_q, seg_ctx, W, interpret=False):
+    """Masked single-device attention, Pallas-fused forward.
+
+    Args:
+      q: `[B, T, H, dh]` rotary'd queries.
+      k_ctx/v_ctx: `[B, S, H, dh]` context (W cache slots then T current
+        tokens, S = W + T; keys already rotary'd).
+      seg_q: `[B, T]` int32 query segment (episode) ids.
+      seg_ctx: `[B, S]` int32 context segment ids (-1 = empty cache slot).
+      W: static int, number of cache slots at the front of the context.
+      interpret: run the kernel in interpreter mode (CPU tests).
+
+    Returns `[B, T, H, dh]` float32 attention output, differentiable
+    w.r.t. q/k_ctx/v_ctx.
+    """
+    return _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W, interpret)
+
+
+def _fwd(q, k_ctx, v_ctx, seg_q, seg_ctx, W, interpret=False):
+    out = _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W, interpret)
+    return out, (q, k_ctx, v_ctx, seg_q, seg_ctx)
+
+
+def _bwd(W, interpret, res, g):
+    q, k_ctx, v_ctx, seg_q, seg_ctx = res
+    B, T, H, dh = q.shape
+    S = k_ctx.shape[1]
+    f32 = jnp.float32
+    q, k_ctx, v_ctx, g = (jnp.asarray(x, f32) for x in (q, k_ctx, v_ctx, g))
+    scale = 1.0 / (dh**0.5)
+
+    # Recompute probabilities (rematerialization), then the classic
+    # softmax-attention backward — plain einsums XLA fuses well.
+    logits = jnp.einsum("bthd,bshd->bhts", q, k_ctx) * scale
+    vis = _visibility(seg_q, seg_ctx, T, S, W)  # [B, T, S]
+    logits = jnp.where(vis[:, None, :, :], logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)  # [B, H, T, S]
+
+    dv = jnp.einsum("bhts,bthd->bshd", p, g)
+    dp = jnp.einsum("bthd,bshd->bhts", g, v_ctx)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhts,bshd->bthd", ds, k_ctx) * scale
+    dk = jnp.einsum("bhts,bthd->bshd", ds, q) * scale
+    # Cotangent dtypes must match the primals' (bf16 inputs get bf16
+    # grads even though the math above runs in f32).
+    dq, dk, dv = (
+        d.astype(r.dtype) for d, r in zip((dq, dk, dv), res[:3])
+    )
+    return dq, dk, dv, None, None
+
+
+windowed_attention.defvjp(_fwd, _bwd)
