@@ -172,6 +172,61 @@ class SignalEnv:
         return self._obs(), reward, self._t >= self._episode_len, False, {}
 
 
+class TaskSignalEnv:
+    """Learnable MULTI-task env: per-task action mapping and reward scale.
+
+    Observation is `[one_hot(target, A); one_hot(task, num_tasks)]`
+    (float32). The rewarded action is `(target + task_id) % A`, so a
+    policy must condition on the task bits — the tasks are genuinely
+    different, not one policy graded twice. Reward is `reward_scale` on a
+    hit, 0 otherwise; with scales ~100x apart, an unnormalized baseline is
+    dominated by the big-reward task's gradients — exactly the failure
+    PopArt's per-task normalization exists to fix (DMLab-30 preset,
+    BASELINE config 5), which the end-to-end test in tests/test_popart.py
+    exploits.
+    """
+
+    def __init__(
+        self,
+        num_actions: int = 4,
+        num_tasks: int = 2,
+        task_id: int = 0,
+        reward_scale: float = 1.0,
+        episode_len: int = 16,
+        seed: int = 0,
+    ):
+        self._rng = np.random.default_rng(seed)
+        self._num_actions = num_actions
+        self._num_tasks = num_tasks
+        self.task_id = task_id
+        self._reward_scale = reward_scale
+        self._episode_len = episode_len
+        self._t = 0
+        self._target = 0
+
+    @property
+    def action_space_n(self) -> int:
+        return self._num_actions
+
+    def _obs(self) -> np.ndarray:
+        obs = np.zeros((self._num_actions + self._num_tasks,), np.float32)
+        obs[self._target] = 1.0
+        obs[self._num_actions + self.task_id] = 1.0
+        return obs
+
+    def reset(self, seed=None):
+        self._t = 0
+        self._target = int(self._rng.integers(self._num_actions))
+        return self._obs(), {}
+
+    def step(self, action):
+        hit = int(action) == (self._target + self.task_id) % self._num_actions
+        reward = self._reward_scale if hit else 0.0
+        self._t += 1
+        self._target = int(self._rng.integers(self._num_actions))
+        return self._obs(), reward, self._t >= self._episode_len, False, {}
+
+
 class CrashingFactory:
     """Picklable env factory that wraps another factory's envs in
     `CrashingEnv` — chaos mode for both thread and process actors."""
