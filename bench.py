@@ -180,6 +180,7 @@ def main() -> None:
                 result["mfu_estimate_fused_best"] = fused_mfu
     section("learner_deep_breakout", lambda: run_bench_deep(jax), gate=tpu_ok)
     section("learner_scaling", lambda: run_bench_scaling(jax), gate=tpu_ok)
+    section("learner_remat", lambda: run_bench_remat(jax), gate=tpu_ok)
     section(
         "vtrace_pallas_vs_scan",
         lambda: run_vtrace_kernel_compare(jax),
@@ -293,6 +294,18 @@ class _LearnerFixture:
         except Exception as e:
             log(f"bench: cost_analysis unavailable: {type(e).__name__}: {e}")
             return 0.0
+
+    def temp_bytes(self) -> int:
+        """Compiled executable's temp (activation) HBM allocation; 0 if
+        the backend doesn't expose memory_analysis."""
+        try:
+            return int(self.step_fn.memory_analysis().temp_size_in_bytes)
+        except Exception as e:
+            log(
+                f"bench: memory_analysis unavailable: "
+                f"{type(e).__name__}: {e}"
+            )
+            return 0
 
 
 def run_bench(jax, tpu_ok: bool) -> dict:
@@ -423,6 +436,53 @@ def run_bench_deep(jax) -> dict:
                 (flops_nolstm * steps / dt2) / 197e12, 4
             )
     log(f"bench: deep learner {steps} steps in {dt:.3f}s -> {fps:,.0f} f/s")
+    return out
+
+
+def run_bench_remat(jax) -> dict:
+    """Torso rematerialization (configs.remat_torso / --remat-torso) on the
+    deep ResNet at a batch where activations dominate HBM: measures the
+    throughput cost and the temp-memory saving of recomputing the torso in
+    the backward pass. The interesting read: how much bigger remat lets B
+    grow before HBM bounds it (MFU campaign lever; SURVEY.md §7)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from torched_impala_tpu.models import AtariDeepTorso
+
+    out = {}
+    T, B, steps = 20, 64, 15
+    for key, torso in (
+        ("plain", AtariDeepTorso(dtype=jnp.bfloat16)),
+        ("remat", nn.remat(AtariDeepTorso)(dtype=jnp.bfloat16)),
+    ):
+        # Per-arm failure isolation: if the PLAIN arm OOMs (the exact
+        # HBM-bound regime remat targets), the remat arm must still be
+        # measured — that is the section's point.
+        try:
+            fx = _LearnerFixture(
+                jax, torso=torso, num_actions=4, T=T, B=B, use_lstm=True
+            )
+            fps, dt = fx.timed_frames_per_sec(steps)
+            entry = {"frames_per_sec": round(fps, 1)}
+            flops = fx.flops_per_step()
+            if flops > 0:
+                entry["mfu_estimate"] = round(
+                    (flops * steps / dt) / 197e12, 4
+                )
+            tb = fx.temp_bytes()
+            if tb:
+                entry["temp_MB"] = round(tb / 1e6, 1)
+        except Exception as e:
+            entry = {"error": f"{type(e).__name__}: {e}"[:200]}
+        out[key] = entry
+        log(f"bench: remat {key} T={T} B={B}: {entry}")
+    if out.get("plain", {}).get("temp_MB") and out.get("remat", {}).get(
+        "temp_MB"
+    ):
+        out["temp_saving_frac"] = round(
+            1.0 - out["remat"]["temp_MB"] / out["plain"]["temp_MB"], 4
+        )
     return out
 
 
@@ -1039,7 +1099,10 @@ if __name__ == "__main__":
             raise TimeoutError("bench wall-clock limit hit (wedged tunnel?)")
 
         signal.signal(signal.SIGALRM, _alarm)
-        signal.alarm(2400)
+        # 2700s: the section list grew this round (remat, feeder,
+        # attention, anakin sweep); still inside tunnel_watch.sh's 3000s
+        # hard timeout so the watcher never SIGKILLs a live bench.
+        signal.alarm(2700)
         main()
     except Exception as e:  # still emit ONE parseable JSON line
         import traceback

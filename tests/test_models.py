@@ -240,3 +240,59 @@ class TestBF16Compute:
         )
         for leaf in jax.tree.leaves(params):
             assert leaf.dtype == jnp.float32, leaf.dtype
+
+
+def test_remat_torso_is_parameter_and_output_transparent():
+    """configs.remat_torso wraps the torso in nn.remat: the param tree,
+    outputs, AND gradients must be identical to the unwrapped net (so
+    checkpoints interchange and the only difference is backward-pass
+    memory) — the MFU-campaign lever for HBM-bound batch sizes."""
+    import dataclasses
+
+    from torched_impala_tpu import configs
+
+    cfg = dataclasses.replace(
+        configs.REGISTRY["breakout"], remat_torso=False
+    )
+    cfg_r = dataclasses.replace(cfg, remat_torso=True)
+    T, B = 3, 2
+    obs = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, 256, size=(T, B, 84, 84, 4), dtype=np.uint8
+        )
+    )
+    first = jnp.zeros((T, B), bool)
+
+    outs, grads = [], []
+    for c in (cfg, cfg_r):
+        agent = configs.make_agent(c)
+        params = agent.init_params(
+            jax.random.key(0), jnp.zeros((84, 84, 4), jnp.uint8)
+        )
+        state = agent.initial_state(B)
+
+        def loss(p):
+            out, _ = agent.net.apply(p, obs, first, state, unroll=True)
+            return (
+                jnp.sum(jnp.sin(out.policy_logits))
+                + jnp.sum(jnp.sin(out.values))
+            )
+
+        outs.append(loss(params))
+        grads.append(jax.grad(loss)(params))
+
+    # Identical param TREE STRUCTURE (checkpoint compatibility)...
+    assert jax.tree_util.tree_structure(
+        grads[0]
+    ) == jax.tree_util.tree_structure(grads[1])
+    # ...identical loss and gradients.
+    np.testing.assert_allclose(
+        float(outs[0]), float(outs[1]), rtol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        grads[0],
+        grads[1],
+    )

@@ -76,6 +76,11 @@ class ExperimentConfig:
     # FLOPs on the MXU's fast path; params, LSTM core, heads, and all loss
     # math stay float32.
     compute_dtype: str = "float32"
+    # Rematerialize the torso in the backward pass (jax.checkpoint via
+    # nn.remat): trades one extra torso forward for not storing its
+    # activations between passes — the standard lever when HBM, not MXU,
+    # bounds the batch size (deep ResNet at large B/T; SURVEY.md §7).
+    remat_torso: bool = False
     # Runtime: "actors" = host actor fleet feeding the device learner (the
     # reference's architecture); "anakin" = fully on-device actor-learner
     # for pure-JAX env families (runtime/anakin.py; env stepping fused into
@@ -137,14 +142,21 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
             "expected 'float32' or 'bfloat16'"
         )
     dtype = jnp.dtype(cfg.compute_dtype)
-    if cfg.model == "mlp":
-        torso = MLPTorso(dtype=dtype)
-    elif cfg.model == "shallow_cnn":
-        torso = AtariShallowTorso(dtype=dtype)
-    elif cfg.model == "deep_resnet":
-        torso = AtariDeepTorso(dtype=dtype)
-    else:
+    torso_cls = {
+        "mlp": MLPTorso,
+        "shallow_cnn": AtariShallowTorso,
+        "deep_resnet": AtariDeepTorso,
+    }.get(cfg.model)
+    if torso_cls is None:
         raise ValueError(f"unknown model {cfg.model!r}")
+    if cfg.remat_torso:
+        # nn.remat is parameter-transparent: the wrapped class produces an
+        # identical param tree (checkpoints interchange with the unwrapped
+        # net) and identical outputs/grads — pinned in tests/test_models.py.
+        import flax.linen as nn
+
+        torso_cls = nn.remat(torso_cls)
+    torso = torso_cls(dtype=dtype)
     # Dense-path attention math: the fused Pallas kernel on TPU devices,
     # the einsum elsewhere — resolved HERE against the actual compute
     # devices (mesh when given, default backend otherwise), mirroring the

@@ -82,6 +82,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "env steps to exercise supervisor restarts")
     p.add_argument("--max-actor-restarts", type=int, default=10,
                    help="per-actor supervisor restart budget")
+    p.add_argument("--remat-torso", action="store_true",
+                   help="rematerialize the torso in the backward pass "
+                        "(trades an extra forward for not storing its "
+                        "activations; for HBM-bound batch sizes)")
     p.add_argument("--native-batcher", action="store_true",
                    help="assemble batches with the C++ batcher (see "
                         "LearnerConfig.native_batcher for the tradeoff)")
@@ -133,6 +137,8 @@ def build_config(args: argparse.Namespace):
         v = getattr(args, flag)
         if v is not None:
             overrides[field] = v
+    if args.remat_torso:
+        overrides["remat_torso"] = True
     cfg = dataclasses.replace(cfg, **overrides) if overrides else cfg
     if args.env_id is not None and not args.fake_envs:
         # The preset's num_actions describes its ORIGINAL env; a
