@@ -24,10 +24,21 @@ Protocol (per worker, lockstep):
                      process (envs are stateless up to the published
                      params) and counts a restart.
 
-The env factory must be PICKLABLE (spawn start method): module-level
-functions, functools.partial of them, or `configs.make_env_factory`'s
-factory objects all work; lambdas/closures raise a clear error at pool
-construction.
+The env factory must be PICKLABLE (forkserver/spawn start methods):
+module-level functions, functools.partial of them, or
+`configs.make_env_factory`'s factory objects all work; lambdas/closures
+raise a clear error at pool construction.
+
+Start method: **forkserver** (spawn fallback off-Linux). Measured on this
+box, a *spawned* worker costs ~13s and ~175MB RSS — interpreter startup
+re-imports the parent's main module and sitecustomize pulls in jax — so a
+256-512 worker preset (BASELINE configs 3-5) would need tens of minutes
+and >40GB just to boot. With forkserver the server process pays those
+imports ONCE (and never initializes any jax backend, so the fork is safe
+and no tunnel state leaks into workers); each worker is then a ~ms fork
+whose jax/numpy pages are shared copy-on-write. `_preload()` warms the
+server with the factory-unpickling imports so workers share those pages
+too.
 """
 
 from __future__ import annotations
@@ -40,7 +51,23 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_CTX = mp.get_context("spawn")
+try:
+    _CTX = mp.get_context("forkserver")
+
+    def _preload() -> None:
+        # Idempotent; first pool construction warms the server. Modules
+        # listed here are imported by workers when unpickling factories —
+        # importing them in the SERVER makes them copy-on-write-shared
+        # across every worker instead of private per-process.
+        _CTX.set_forkserver_preload(
+            ["torched_impala_tpu.configs", "torched_impala_tpu.envs"]
+        )
+
+except ValueError:  # platform without forkserver
+    _CTX = mp.get_context("spawn")
+
+    def _preload() -> None:
+        pass
 
 
 def _worker_main(
@@ -173,7 +200,8 @@ class ProcessEnvPool:
             raise ValueError(
                 "process actors need a picklable env factory (module-level "
                 "function, functools.partial, or configs.make_env_factory "
-                "output) — closures/lambdas cannot cross the spawn boundary"
+                "output) — closures/lambdas cannot cross the worker-process "
+                "(pickle) boundary; forkserver and spawn both require it"
             ) from e
         self._num_workers = num_workers
         self._envs_per_worker = envs_per_worker
@@ -200,9 +228,10 @@ class ProcessEnvPool:
         self.task_ids: List[int] = [0] * n
         self._closed = False
         try:
-            # Start every worker before waiting on any: interpreter startup
-            # (sitecustomize imports jax) dominates spawn latency, so the
-            # ready-waits overlap instead of serializing.
+            # Start every worker before waiting on any. Under forkserver a
+            # start is a ~ms fork; under the spawn fallback interpreter
+            # startup dominates, so the ready-waits overlap either way.
+            _preload()
             for w in range(num_workers):
                 self._start(w)
             for w in range(num_workers):
