@@ -1,0 +1,97 @@
+"""Multi-task IMPALA with PopArt value normalization (library API).
+
+Two tasks with DIFFERENT rewarded-action mappings and reward scales 100x
+apart train through one shared policy. Without PopArt the big-reward
+task's gradients swamp (and destabilize) the shared net — measured in
+tests/test_popart.py's ablation, it ends up WORSE than random. With
+PopArt each task's value targets are normalized by per-task running
+statistics (Hessel et al. 2018), and both tasks learn.
+
+Run from the repo root: `python examples/multitask_popart.py` (~1 min).
+Expected: both tasks' greedy eval beats random by >=2x, and the learned
+per-task sigma ratio is within an order of magnitude of the 100x scale
+ratio.
+"""
+
+import os
+import sys
+
+# Runnable straight from a source checkout; with a pip-installed package
+# this block is unnecessary.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # portability; delete on TPU
+
+import numpy as np
+import optax
+
+from torched_impala_tpu.envs.fake import TaskSignalEnv
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.ops import popart
+from torched_impala_tpu.ops.popart import PopArtConfig
+from torched_impala_tpu.runtime import LearnerConfig
+from torched_impala_tpu.runtime.evaluator import run_episodes
+from torched_impala_tpu.runtime.loop import train
+
+SCALES = {0: 1.0, 1: 100.0}
+
+
+def env_factory(seed, env_index=None):
+    task = (env_index or 0) % 2
+    return TaskSignalEnv(task_id=task, reward_scale=SCALES[task], seed=seed)
+
+
+def main():
+    # num_values=2: the value head emits one normalized value per task;
+    # PopArt selects each env's column and keeps the head's unnormalized
+    # outputs continuous as the statistics move (rescale_params).
+    agent = Agent(
+        ImpalaNet(
+            num_actions=4,
+            torso=MLPTorso(hidden_sizes=(32, 32)),
+            num_values=2,
+        )
+    )
+    pa_cfg = PopArtConfig(num_values=2, step_size=1e-2)
+    result = train(
+        agent=agent,
+        env_factory=env_factory,
+        example_obs=np.zeros((6,), np.float32),
+        num_actors=2,
+        envs_per_actor=2,
+        learner_config=LearnerConfig(
+            batch_size=8, unroll_length=12, popart=pa_cfg
+        ),
+        optimizer=optax.rmsprop(2e-3, decay=0.99, eps=1e-7),
+        total_steps=300,
+        actor_device=None,
+        seed=0,
+    )
+    sig = np.asarray(popart.sigma(result.learner.popart_state, pa_cfg))
+    print(f"per-task sigma: {sig} (ratio {sig[1] / sig[0]:.0f}x; "
+          f"reward scales differ 100x)")
+    for task, scale in SCALES.items():
+        ev = run_episodes(
+            agent=agent,
+            params=result.learner.params,
+            env=TaskSignalEnv(
+                task_id=task, reward_scale=scale, seed=123 + task
+            ),
+            num_episodes=10,
+            greedy=True,
+            seed=task,
+        )
+        random_baseline = 16 * scale / 4
+        print(
+            f"task {task}: greedy eval {ev.mean_return:8.1f} "
+            f"(random policy {random_baseline:.0f}) "
+            f"{'LEARNED' if ev.mean_return > 2 * random_baseline else 'NOT LEARNED'}"
+        )
+
+
+if __name__ == "__main__":
+    main()

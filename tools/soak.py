@@ -31,7 +31,6 @@ Usage: python tools/soak.py --out /tmp/soak [--soak-minutes 60]
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import signal
 import subprocess
@@ -90,11 +89,29 @@ def latest_step(ckpt: str) -> int:
         "from torched_impala_tpu.utils.checkpoint import Checkpointer;"
         f"print(Checkpointer({ckpt!r}).latest_step() or 0)"
     )
-    out = subprocess.run(
-        [sys.executable, "-c", code], cwd=REPO, capture_output=True,
-        text=True, timeout=120,
-    )
-    return int(out.stdout.strip().splitlines()[-1])
+    # Retry: the probe can land right after a SIGKILL while the newest
+    # checkpoint dir is mid-write; a transient failure must not abort an
+    # hour-long soak with a context-free parse error.
+    last_err = ""
+    for _ in range(3):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], cwd=REPO,
+                capture_output=True, text=True, timeout=120,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = "probe timed out after 120s"
+            continue
+        lines = out.stdout.strip().splitlines()
+        if out.returncode == 0 and lines:
+            try:
+                return int(lines[-1])
+            except ValueError:
+                last_err = f"unparsable stdout: {lines[-1]!r}"
+        else:
+            last_err = out.stderr.strip()[-300:] or f"rc={out.returncode}"
+        time.sleep(5)
+    raise RuntimeError(f"checkpoint-step probe failed 3x: {last_err}")
 
 
 def eval_ckpt(ckpt: str, args) -> float:
@@ -123,19 +140,6 @@ def eval_ckpt(ckpt: str, args) -> float:
             f"{out.stderr[-400:]}"
         )
     return val
-
-
-def read_curve(logdir: str):
-    path = os.path.join(logdir, "cartpole.jsonl")
-    rows = []
-    if os.path.exists(path):
-        with open(path) as f:
-            for line in f:
-                try:
-                    rows.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass  # a SIGKILL can truncate the final line
-    return rows
 
 
 def main() -> int:

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Keep a tunnel watcher alive until a real-chip bench capture lands.
+# tunnel_watch.sh gives up after 60 iterations (~10h); this respawner
+# relaunches it whenever it has exited without having committed a fresh
+# TPU capture, so a late tunnel heal still gets benched. Exits once
+# BENCH_live.json carries a TPU backend newer than the round start.
+cd /root/repo
+for i in $(seq 1 48); do
+  alive=$(python3 - <<'EOF'
+import os
+n = 0
+for pid in os.listdir('/proc'):
+    if not pid.isdigit():
+        continue
+    try:
+        with open(f'/proc/{pid}/cmdline', 'rb') as f:
+            argv = [a for a in f.read().split(b'\0') if a]
+    except Exception:
+        continue
+    # Exact argv positions only: never substring-match a shell's -c blob
+    # (a pattern like 'tunnel_watch' matches the matcher's own shell).
+    if len(argv) >= 2 and os.path.basename(argv[0]) == b'bash' \
+            and argv[1].endswith(b'tunnel_watch.sh'):
+        n += 1
+print(n)
+EOF
+)
+  fresh=$(python3 -c "
+import json
+try:
+    d = json.load(open('BENCH_live.json'))
+    ok = d.get('backend') == 'tpu' and 'feeder_saturation' in d
+except Exception:
+    ok = False
+print(1 if ok else 0)")
+  if [ "$fresh" = "1" ]; then
+    echo "$(date +%H:%M:%S) fresh TPU capture present; respawner done" >> /tmp/tunnel_watch.log
+    exit 0
+  fi
+  if [ "$alive" = "0" ]; then
+    echo "$(date +%H:%M:%S) respawner: relaunching tunnel_watch.sh" >> /tmp/tunnel_watch.log
+    nohup setsid bash /root/repo/tunnel_watch.sh < /dev/null > /dev/null 2>&1 &
+  fi
+  sleep 900
+done
