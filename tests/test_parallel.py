@@ -142,3 +142,69 @@ def test_batch_lands_sharded_over_data_axis():
     assert shard_shape == (T + 1, 1, 4)
     spec = obs.sharding.spec
     assert spec[1] == DATA_AXIS
+
+
+@pytest.mark.parametrize("use_lstm", [False, True])
+def test_tensor_parallel_step_matches_single_device(use_lstm):
+    """('data','model') = (2, 4): weight matrices shard over the model
+    axis (Megatron column layout via parallel.model_shardings) and the
+    batch over data — one SGD step must match the single-device step
+    bit-for-tolerance, with XLA inserting whatever collectives the
+    layout needs."""
+    T, B = 5, 8
+    agent = _agent(use_lstm)
+    params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    trajs = _collect_batch(agent, params0, T, B)
+
+    mesh = make_mesh(num_data=2, num_model=4)
+    single, logs_single = _run_learner(agent, list(trajs), None, T, B)
+    tp, logs_tp = _run_learner(agent, list(trajs), mesh, T, B)
+
+    np.testing.assert_allclose(
+        logs_single["total_loss"], logs_tp["total_loss"], rtol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        single.params,
+        tp.params,
+    )
+
+
+def test_tensor_parallel_weights_actually_sharded():
+    """Engagement check: the torso kernel [4, 16] must live as 4-way
+    last-dim shards (not replicas), while the policy head [16, 2]
+    (2 % 4 != 0) stays replicated; optimizer state mirrors both. Then a
+    get_state -> set_state roundtrip must land the restored leaves back
+    on the same layouts (checkpoint/resume under TP)."""
+    T, B = 4, 8
+    agent = _agent()
+    params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    trajs = _collect_batch(agent, params0, T, B)
+    mesh = make_mesh(num_data=2, num_model=4)
+    learner, _ = _run_learner(agent, trajs, mesh, T, B)
+
+    def leaf(tree, *path):
+        node = tree["params"]
+        for p in path:
+            node = node[p]
+        return node
+
+    torso_k = leaf(learner.params, "torso", "Dense_0", "kernel")
+    assert torso_k.shape == (4, 16)
+    assert torso_k.sharding.shard_shape(torso_k.shape) == (4, 4)
+    head_k = leaf(learner.params, "policy_head", "kernel")
+    assert head_k.sharding.is_fully_replicated
+
+    state = learner.get_state()  # host gather
+    assert isinstance(
+        np.asarray(leaf(state["params"], "torso", "Dense_0", "kernel")),
+        np.ndarray,
+    )
+    learner.set_state(state)
+    torso_k2 = leaf(learner.params, "torso", "Dense_0", "kernel")
+    assert torso_k2.sharding.shard_shape(torso_k2.shape) == (4, 4)
+    np.testing.assert_allclose(
+        np.asarray(torso_k2), np.asarray(torso_k), rtol=1e-6
+    )

@@ -5,6 +5,7 @@
 # TPU capture, so a late tunnel heal still gets benched. Exits once
 # BENCH_live.json carries a TPU backend newer than the round start.
 cd /root/repo
+START_TS=$(date +%s)
 for i in $(seq 1 48); do
   alive=$(python3 - <<'EOF'
 import os
@@ -26,10 +27,11 @@ print(n)
 EOF
 )
   fresh=$(python3 -c "
-import json
+import json, os
 try:
     d = json.load(open('BENCH_live.json'))
-    ok = d.get('backend') == 'tpu' and 'feeder_saturation' in d
+    ok = (d.get('backend') == 'tpu' and 'feeder_saturation' in d
+          and os.path.getmtime('BENCH_live.json') > $START_TS)
 except Exception:
     ok = False
 print(1 if ok else 0)")

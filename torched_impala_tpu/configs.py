@@ -118,6 +118,11 @@ class ExperimentConfig:
     # Parallelism: shard the learner batch over this many devices (DP);
     # 0 = single device. SURVEY.md §3b DP row.
     dp_devices: int = 0
+    # Tensor parallelism: widen the mesh's 'model' axis to this many
+    # devices — weight matrices shard by output features
+    # (parallel.model_shardings), composing with DP as a ('data','model')
+    # mesh. 0/1 = off.
+    tp_devices: int = 0
     popart_step_size: float = 3e-4
 
     @property

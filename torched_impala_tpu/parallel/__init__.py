@@ -7,6 +7,7 @@ from torched_impala_tpu.parallel.mesh import (  # noqa: F401
     MODEL_AXIS,
     batch_sharding,
     make_mesh,
+    model_shardings,
     replicated,
     state_sharding,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "MODEL_AXIS",
     "batch_sharding",
     "make_mesh",
+    "model_shardings",
     "replicated",
     "ring_attention",
     "ring_attention_sharded",

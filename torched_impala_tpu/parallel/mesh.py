@@ -59,6 +59,39 @@ def state_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def model_shardings(mesh: Mesh, tree):
+    """Tensor-parallel sharding tree over the mesh's `model` axis.
+
+    Weight leaves (ndim >= 2) whose trailing (output-feature) dimension
+    divides the model-axis size shard that dimension over MODEL_AXIS —
+    Dense/conv kernels split by output features, the classic Megatron
+    column layout; biases, scalars, and indivisible leaves replicate.
+    Because optimizer-state leaves mirror their parameters' shapes, the
+    same shape rule applied to params and opt_state yields consistent
+    layouts. Correctness never depends on the choice: shardings only
+    seed the XLA partitioner, which inserts the collectives any layout
+    needs (the scaling-book recipe) — pinned against the single-device
+    step in tests/test_parallel.py. With a size-1 model axis everything
+    replicates (the DP-only layout, unchanged).
+    """
+    n = mesh.shape[MODEL_AXIS]
+
+    def rule(leaf):
+        shape = getattr(leaf, "shape", ())
+        if (
+            n > 1
+            and len(shape) >= 2
+            and shape[-1] % n == 0
+            and shape[-1] >= n
+        ):
+            return NamedSharding(
+                mesh, P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+            )
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(rule, tree)
+
+
 def data_seq_mesh(
     num_data: int,
     num_seq: int,

@@ -54,6 +54,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Parallelism.
     p.add_argument("--dp", type=int, default=None,
                    help="shard learner batch over N devices (-1 = all)")
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel 'model' mesh axis of N devices "
+                        "(weight matrices shard by output features; "
+                        "composes with --dp as a ('data','model') mesh)")
     p.add_argument("--sp", type=int, default=None,
                    help="shard the transformer unroll's time axis over N "
                         "devices (('data','seq') mesh with --dp; needs "
@@ -130,6 +134,7 @@ def build_config(args: argparse.Namespace):
         ("total_env_frames", "total_env_frames"),
         ("lr", "lr"),
         ("dp", "dp_devices"),
+        ("tp", "tp_devices"),
         ("sp", "sp_devices"),
         ("transformer_attention", "transformer_attention"),
         ("env_id", "env_id"),
@@ -217,6 +222,20 @@ def main(argv=None) -> int:
             f"Pick unroll-length = k*{cfg.sp_devices} - 1."
         )
 
+    if cfg.tp_devices and cfg.tp_devices < 0:
+        # No '-1 = all' for tp (unlike --dp): the model axis size changes
+        # the weight layouts, so it must be chosen, not inferred — and
+        # silently ignoring a negative would fake a TP run (ADVICE-class
+        # footgun).
+        raise SystemExit(
+            f"--tp must be a concrete axis size >= 2, got {cfg.tp_devices}"
+        )
+    if cfg.sp_devices and cfg.tp_devices and cfg.tp_devices > 1:
+        raise SystemExit(
+            "--tp and --sp build different meshes (('data','model') vs "
+            "('data','seq')); combine tp with dp only"
+        )
+
     mesh = None
     if cfg.sp_devices:
         # Combined data+sequence parallelism: ('data','seq') mesh; the
@@ -235,6 +254,17 @@ def main(argv=None) -> int:
             mesh = data_seq_mesh(dp, cfg.sp_devices)
         except ValueError as e:
             raise SystemExit(str(e)) from e
+    elif cfg.tp_devices and cfg.tp_devices > 1:
+        # ('data','model') mesh: batch over data, weight matrices over
+        # model (parallel.model_shardings). --dp sizes the data axis
+        # (-1/0 = whatever the device count allows).
+        tp = cfg.tp_devices
+        dp = (
+            max(1, len(jax.devices()) // tp)
+            if cfg.dp_devices in (0, -1)
+            else cfg.dp_devices
+        )
+        mesh = make_mesh(num_data=dp, num_model=tp)
     elif cfg.dp_devices:  # 0 = single-device; -1 = all; N = N devices
         n = len(jax.devices()) if cfg.dp_devices == -1 else cfg.dp_devices
         mesh = make_mesh(num_data=n)

@@ -38,6 +38,7 @@ from torched_impala_tpu.ops.popart import PopArtConfig
 from torched_impala_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
+    model_shardings,
     replicated,
     state_sharding,
 )
@@ -180,9 +181,13 @@ class Learner:
         mesh: Optional[Mesh] = None,
     ) -> None:
         """`mesh=None` → single-device jit; `mesh=Mesh(..., ('data','model'))`
-        → batch sharded over `data`, params/optimizer replicated, gradient
-        all-reduce inserted by the XLA partitioner over ICI (SURVEY.md §3b
-        DP row). The data-axis size must divide batch_size."""
+        → batch sharded over `data` (gradient all-reduce inserted by the
+        XLA partitioner over ICI, SURVEY.md §3b DP row) and, when the
+        `model` axis is wider than 1, params/optimizer tensor-parallel
+        over it (`parallel.model_shardings`: output-feature dimensions of
+        weight matrices split Megatron-column-style, activations
+        repartitioned by XLA as needed). The data-axis size must divide
+        batch_size."""
         self._agent = agent
         self._optimizer = optimizer
         self._config = config
@@ -243,9 +248,20 @@ class Learner:
         )
         if mesh is not None:
             rep = replicated(mesh)
-            self._params = jax.device_put(self._params, rep)
-            self._opt_state = jax.device_put(self._opt_state, rep)
+            # DP-only meshes (model axis 1) come out fully replicated;
+            # wider model axes shard weight matrices tensor-parallel.
+            self._param_shardings = model_shardings(mesh, self._params)
+            self._opt_shardings = model_shardings(mesh, self._opt_state)
+            self._params = jax.device_put(
+                self._params, self._param_shardings
+            )
+            self._opt_state = jax.device_put(
+                self._opt_state, self._opt_shardings
+            )
             self._popart_state = jax.device_put(self._popart_state, rep)
+        else:
+            self._param_shardings = None
+            self._opt_shardings = None
         self.num_frames = 0
         self.num_steps = 0
 
@@ -312,8 +328,18 @@ class Learner:
             self._train_step = jax.jit(
                 step_impl,
                 donate_argnums=(0, 1, 2),
-                in_shardings=(rep, rep, rep) + self._batch_shardings,
-                out_shardings=(rep, rep, rep, rep),
+                in_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings,
+                    rep,
+                )
+                + self._batch_shardings,
+                out_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings,
+                    rep,
+                    rep,
+                ),
             )
 
     # ---- the hot loop: one fused XLA program ---------------------------
@@ -745,8 +771,10 @@ class Learner:
                     popart_state = popart_ops.PopArtState(*popart_state)
         if self._mesh is not None:
             rep = replicated(self._mesh)
-            params = jax.device_put(params, rep)
-            opt_state = jax.device_put(opt_state, rep)
+            # Same layouts as construction (tensor-parallel leaves land
+            # back on their shards; DP-only meshes replicate).
+            params = jax.device_put(params, self._param_shardings)
+            opt_state = jax.device_put(opt_state, self._opt_shardings)
             popart_state = jax.device_put(popart_state, rep)
         else:
             params = jax.device_put(params)
