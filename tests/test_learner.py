@@ -547,3 +547,126 @@ def test_fused_dispatch_never_overshoots_budget():
         learner.run(max_steps=3)
     assert learner.num_steps == 2  # largest multiple of K=2 within 3
     assert any("not a multiple" in str(w.message) for w in caught)
+
+
+class TestGradAccum:
+    """grad_accum=G must produce the FULL-batch update exactly: same
+    params after one step as G=1 on the same trajectories, for both loss
+    reductions and with recurrent state; composes with fused dispatch
+    and the DP mesh; PopArt is rejected."""
+
+    @staticmethod
+    def _collect(agent, params, T, B):
+        from torched_impala_tpu.runtime import ParamStore
+
+        store = ParamStore()
+        store.publish(0, params)
+        actor = Actor(
+            actor_id=0,
+            env=ScriptedEnv(episode_len=4),
+            agent=agent,
+            param_store=store,
+            enqueue=lambda t: None,
+            unroll_length=T,
+            seed=0,
+        )
+        return [actor.unroll(params) for _ in range(B)]
+
+    def _step(self, agent, trajs, T, B, G, reduction="sum", mesh=None,
+              steps_per_dispatch=1):
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=B,
+                unroll_length=T,
+                loss=ImpalaLossConfig(reduction=reduction),
+                grad_accum=G,
+                steps_per_dispatch=steps_per_dispatch,
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+            mesh=mesh,
+        )
+        for t in trajs * steps_per_dispatch:
+            learner.enqueue(t)
+        learner.start()
+        logs = learner.step_once(timeout=120)
+        learner.stop()
+        return learner, logs
+
+    @pytest.mark.parametrize("reduction", ["sum", "mean"])
+    @pytest.mark.parametrize("use_lstm", [False, True])
+    def test_matches_full_batch(self, reduction, use_lstm):
+        T, B = 5, 8
+        agent = _agent(use_lstm=use_lstm)
+        params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+        trajs = self._collect(agent, params0, T, B)
+        full, logs_full = self._step(agent, list(trajs), T, B, 1, reduction)
+        acc, logs_acc = self._step(agent, list(trajs), T, B, 4, reduction)
+        np.testing.assert_allclose(
+            float(logs_full["total_loss"]), float(logs_acc["total_loss"]),
+            rtol=1e-5,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            full.params,
+            acc.params,
+        )
+
+    def test_composes_with_fused_dispatch_and_mesh(self):
+        from torched_impala_tpu.parallel import make_mesh
+
+        T, B = 4, 8
+        agent = _agent()
+        params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+        trajs = self._collect(agent, params0, T, B)
+        plain, _ = self._step(agent, list(trajs), T, B, 1)
+        combo, _ = self._step(
+            agent, list(trajs), T, B, 2,
+            mesh=make_mesh(num_data=4), steps_per_dispatch=1,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            plain.params,
+            combo.params,
+        )
+        fused, _ = self._step(
+            agent, list(trajs), T, B, 2, steps_per_dispatch=2
+        )
+        assert fused.num_steps == 2
+
+    def test_validation(self):
+        from torched_impala_tpu.ops.popart import PopArtConfig
+
+        agent = _agent()
+        with pytest.raises(ValueError, match="not divisible by"):
+            Learner(
+                agent=agent,
+                optimizer=optax.sgd(1e-2),
+                config=LearnerConfig(batch_size=6, unroll_length=4,
+                                     grad_accum=4),
+                example_obs=np.zeros((4,), np.float32),
+                rng=jax.random.key(0),
+            )
+        with pytest.raises(ValueError, match="PopArt"):
+            Learner(
+                agent=Agent(
+                    ImpalaNet(
+                        num_actions=2,
+                        torso=MLPTorso(hidden_sizes=(16,)),
+                        num_values=2,
+                    )
+                ),
+                optimizer=optax.sgd(1e-2),
+                config=LearnerConfig(
+                    batch_size=8, unroll_length=4, grad_accum=2,
+                    popart=PopArtConfig(num_values=2),
+                ),
+                example_obs=np.zeros((4,), np.float32),
+                rng=jax.random.key(0),
+            )

@@ -101,6 +101,16 @@ def entropy_loss(
     return _reduce(-entropy(logits), mask, reduction)
 
 
+# Log keys that assemble_loss emits as SUMS over the batch when
+# reduction="sum" (everything else it emits is a per-step mean).
+# Consumers that combine logs across microbatches (Learner.grad_accum)
+# key off this set, so it must stay next to the code that owns the
+# reduction semantics.
+SUM_REDUCED_LOG_KEYS = frozenset(
+    {"pg_loss", "baseline_loss", "entropy_loss", "total_loss"}
+)
+
+
 def assemble_loss(
     *,
     pg: jax.Array,

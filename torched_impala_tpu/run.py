@@ -47,6 +47,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="fuse K SGD steps into one dispatched XLA program "
                         "(amortizes host dispatch latency; params publish "
                         "every K steps — see LearnerConfig)")
+    p.add_argument("--grad-accum", type=int, default=None,
+                   help="accumulate gradients over G microbatches before "
+                        "one optimizer update (same numbers as the full "
+                        "batch, ~G-fold smaller activation footprint)")
     p.add_argument("--total-steps", type=int, default=None,
                    help="learner updates (default: total_env_frames/T*B)")
     p.add_argument("--total-env-frames", type=int, default=None)
@@ -290,12 +294,25 @@ def main(argv=None) -> int:
                 "runtime='anakin' is single-controller (multi-host needs "
                 "the actor runtime); drop --coordinator/--num-hosts"
             )
+        if args.grad_accum is not None:
+            # Silently ignoring it would fake the documented HBM lever
+            # (anakin fuses rollout+update; it has no microbatch path).
+            raise SystemExit(
+                "--grad-accum applies to the actor-runtime learner only; "
+                "runtime='anakin' has no microbatch path"
+            )
         return run_anakin(args, cfg, agent, mesh, checkpointer)
 
     learner_config = configs.make_learner_config(cfg)
     if args.native_batcher:
         learner_config = dataclasses.replace(
             learner_config, native_batcher=True
+        )
+    if args.grad_accum is not None:
+        # No truthiness filter: 0 must reach the Learner's own >= 1
+        # validation and fail loudly.
+        learner_config = dataclasses.replace(
+            learner_config, grad_accum=args.grad_accum
         )
 
     env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)

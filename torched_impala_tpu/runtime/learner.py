@@ -33,7 +33,11 @@ from jax.sharding import Mesh
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.ops import popart as popart_ops
 from torched_impala_tpu.ops import vtrace as vtrace_ops
-from torched_impala_tpu.ops.losses import ImpalaLossConfig, impala_loss
+from torched_impala_tpu.ops.losses import (
+    SUM_REDUCED_LOG_KEYS,
+    ImpalaLossConfig,
+    impala_loss,
+)
 from torched_impala_tpu.ops.popart import PopArtConfig
 from torched_impala_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -77,6 +81,18 @@ class LearnerConfig:
     # step (actor staleness grows by up to K-1 extra updates — V-trace is
     # built for exactly this), and K batches are resident on device at once.
     steps_per_dispatch: int = 1
+    # Accumulate gradients over G microbatches of batch_size/G inside the
+    # same XLA program before ONE optimizer update: the activation
+    # footprint shrinks ~G-fold (only one microbatch's activations are
+    # live at a time, plus a grads-sized accumulator) while the update is
+    # numerically the full-batch update — exact for both loss reductions
+    # (masks are all-ones on this path), pinned by tests. The HBM lever
+    # for batch sizes whose activations don't fit even with remat;
+    # composes with steps_per_dispatch (accumulation nests inside each
+    # fused step). Incompatible with PopArt (its stats EMA is not
+    # accumulation-invariant); batch_size must divide by G (and the
+    # per-microbatch batch by the mesh's data axis).
+    grad_accum: int = 1
     # Assemble batches with the native (C++) batcher (native/batcher.cpp).
     # Measured on this image (32x Atari unrolls): numpy np.stack already
     # releases the GIL in its copy loops and is ~18% faster single-thread,
@@ -302,6 +318,27 @@ class Learner:
                 f"steps_per_dispatch must be >= 1, got "
                 f"{config.steps_per_dispatch}"
             )
+        G = config.grad_accum
+        if G < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {G}")
+        if G > 1:
+            if config.popart is not None:
+                raise ValueError(
+                    "grad_accum > 1 is incompatible with PopArt: the "
+                    "per-update stats EMA is not accumulation-invariant"
+                )
+            if config.batch_size % G:
+                raise ValueError(
+                    f"batch_size {config.batch_size} not divisible by "
+                    f"grad_accum {G}"
+                )
+            if mesh is not None and (config.batch_size // G) % mesh.shape[
+                DATA_AXIS
+            ]:
+                raise ValueError(
+                    f"microbatch {config.batch_size // G} not divisible "
+                    f"by data axis {mesh.shape[DATA_AXIS]}"
+                )
         fused = config.steps_per_dispatch > 1
         step_impl = self._train_multi_impl if fused else self._train_step_impl
         if mesh is None:
@@ -344,10 +381,9 @@ class Learner:
 
     # ---- the hot loop: one fused XLA program ---------------------------
 
-    def _train_step_impl(
+    def _compute_grads(
         self,
         params,
-        opt_state,
         popart_state,
         obs,
         first,
@@ -358,6 +394,7 @@ class Learner:
         tasks,
         agent_state,
     ):
+        """(grads, logs, new_popart_state) for one (micro)batch."""
         cfg = self._config.loss
         pa_cfg = self._config.popart
 
@@ -400,6 +437,74 @@ class Learner:
         (_, (logs, new_popart)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
+        return grads, logs, new_popart
+
+    def _train_step_impl(
+        self,
+        params,
+        opt_state,
+        popart_state,
+        obs,
+        first,
+        actions,
+        behaviour_logits,
+        rewards,
+        cont,
+        tasks,
+        agent_state,
+    ):
+        G = self._config.grad_accum
+        if G == 1:
+            grads, logs, new_popart = self._compute_grads(
+                params, popart_state, obs, first, actions,
+                behaviour_logits, rewards, cont, tasks, agent_state,
+            )
+        else:
+            # Split the batch axis into [G, Bm] and scan, accumulating
+            # grads; only one microbatch's activations are ever live.
+            Bm = self._config.batch_size // G
+
+            def split_tb(x):  # [T(+1), B, ...] -> [G, T(+1), Bm, ...]
+                return x.reshape(
+                    (x.shape[0], G, Bm) + x.shape[2:]
+                ).swapaxes(0, 1)
+
+            def split_b(x):  # [B, ...] -> [G, Bm, ...]
+                return x.reshape((G, Bm) + x.shape[1:])
+
+            micro = (
+                split_tb(obs),
+                split_tb(first),
+                split_tb(actions),
+                split_tb(behaviour_logits),
+                split_tb(rewards),
+                split_tb(cont),
+                split_b(tasks),
+                jax.tree.map(split_b, agent_state),
+            )
+
+            def body(acc, xs):
+                g, logs, _ = self._compute_grads(
+                    params, popart_state, *xs
+                )
+                return jax.tree.map(jnp.add, acc, g), logs
+
+            acc0 = jax.tree.map(jnp.zeros_like, params)
+            grads, logs_seq = jax.lax.scan(body, acc0, micro)
+            if self._config.loss.reduction == "mean":
+                # Microbatch grads are means over Bm; the full-batch mean
+                # is their average (equal per-microbatch step counts).
+                grads = jax.tree.map(lambda g: g / G, grads)
+            logs = {
+                k: jnp.sum(v, axis=0)
+                if (
+                    k in SUM_REDUCED_LOG_KEYS
+                    and self._config.loss.reduction == "sum"
+                )
+                else jnp.mean(v, axis=0)
+                for k, v in logs_seq.items()
+            }
+            new_popart = popart_state  # PopArt rejected with grad_accum
         grad_norm = optax.global_norm(grads)
         if self._config.max_grad_norm is not None:
             scale = jnp.minimum(
@@ -408,6 +513,7 @@ class Learner:
             grads = jax.tree.map(lambda g: g * scale, grads)
         updates, opt_state = self._optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        pa_cfg = self._config.popart
         if pa_cfg is not None:
             # Preserve outputs precisely across the stats move (the "Art"
             # half of PopArt): rescale the value head for the new (mu, sigma).
