@@ -211,7 +211,16 @@ class _LearnerFixture:
     warmup, timing, trace capture, and cost_analysis."""
 
     def __init__(
-        self, jax, *, torso, num_actions, T, B, use_lstm=False, fused_k=1
+        self,
+        jax,
+        *,
+        torso,
+        num_actions,
+        T,
+        B,
+        use_lstm=False,
+        fused_k=1,
+        grad_accum=1,
     ):
         import jax.numpy as jnp
         import numpy as np
@@ -234,6 +243,7 @@ class _LearnerFixture:
                 loss=ImpalaLossConfig(reduction="sum"),
                 publish_interval=1_000_000,
                 steps_per_dispatch=fused_k,
+                grad_accum=grad_accum,
             ),
             example_obs=np.zeros((84, 84, 4), np.uint8),
             rng=jax.random.key(0),
@@ -440,11 +450,12 @@ def run_bench_deep(jax) -> dict:
 
 
 def run_bench_remat(jax) -> dict:
-    """Torso rematerialization (configs.remat_torso / --remat-torso) on the
-    deep ResNet at a batch where activations dominate HBM: measures the
-    throughput cost and the temp-memory saving of recomputing the torso in
-    the backward pass. The interesting read: how much bigger remat lets B
-    grow before HBM bounds it (MFU campaign lever; SURVEY.md §7)."""
+    """Activation-memory levers on the deep ResNet at a batch where
+    activations dominate HBM: torso rematerialization (configs.remat_torso
+    / --remat-torso) and gradient accumulation (LearnerConfig.grad_accum /
+    --grad-accum), alone and combined — throughput cost vs temp-HBM saving
+    of each. The interesting read: how much bigger each lever lets B grow
+    before HBM bounds it (MFU campaign; SURVEY.md §7)."""
     import flax.linen as nn
     import jax.numpy as jnp
 
@@ -452,16 +463,21 @@ def run_bench_remat(jax) -> dict:
 
     out = {}
     T, B, steps = 20, 64, 15
-    for key, torso in (
-        ("plain", AtariDeepTorso(dtype=jnp.bfloat16)),
-        ("remat", nn.remat(AtariDeepTorso)(dtype=jnp.bfloat16)),
+    plain = AtariDeepTorso(dtype=jnp.bfloat16)
+    remat = nn.remat(AtariDeepTorso)(dtype=jnp.bfloat16)
+    for key, torso, accum in (
+        ("plain", plain, 1),
+        ("remat", remat, 1),
+        ("accum4", plain, 4),
+        ("remat_accum4", remat, 4),
     ):
         # Per-arm failure isolation: if the PLAIN arm OOMs (the exact
         # HBM-bound regime remat targets), the remat arm must still be
         # measured — that is the section's point.
         try:
             fx = _LearnerFixture(
-                jax, torso=torso, num_actions=4, T=T, B=B, use_lstm=True
+                jax, torso=torso, num_actions=4, T=T, B=B, use_lstm=True,
+                grad_accum=accum,
             )
             fps, dt = fx.timed_frames_per_sec(steps)
             entry = {"frames_per_sec": round(fps, 1)}

@@ -635,10 +635,37 @@ class TestGradAccum:
             plain.params,
             combo.params,
         )
+        # Fused x accum NUMERICS: K=2 fused steps each accumulating G=2
+        # microbatches must equal two sequential G=2 steps on the same two
+        # batches (FIFO order makes the batch split identical) — catches
+        # e.g. the inner scan accumulating against stale params.
+        two_batches = self._collect(agent, params0, T, 2 * B)
+        seq = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=B, unroll_length=T, grad_accum=2
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+        )
+        for t in two_batches:
+            seq.enqueue(t)
+        seq.start()
+        seq.step_once(timeout=120)
+        seq.step_once(timeout=120)
+        seq.stop()
         fused, _ = self._step(
-            agent, list(trajs), T, B, 2, steps_per_dispatch=2
+            agent, list(two_batches), T, B, 2, steps_per_dispatch=2
         )
         assert fused.num_steps == 2
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            seq.params,
+            fused.params,
+        )
 
     def test_validation(self):
         from torched_impala_tpu.ops.popart import PopArtConfig

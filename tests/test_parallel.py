@@ -208,3 +208,32 @@ def test_tensor_parallel_weights_actually_sharded():
     np.testing.assert_allclose(
         np.asarray(torso_k2), np.asarray(torso_k), rtol=1e-6
     )
+
+
+def test_tensor_parallel_composes_with_fused_dispatch():
+    """steps_per_dispatch=2 on the (2,4) TP mesh: the [K, ...] superbatch
+    scan must thread TP-sharded params through both steps."""
+    T, B, K = 4, 8, 2
+    agent = _agent()
+    params0 = agent.init_params(jax.random.key(0), jnp.zeros((4,)))
+    trajs = _collect_batch(agent, params0, T, B * K)
+    mesh = make_mesh(num_data=2, num_model=4)
+    learner = Learner(
+        agent=agent,
+        optimizer=optax.sgd(1e-2),
+        config=LearnerConfig(
+            batch_size=B, unroll_length=T, steps_per_dispatch=K
+        ),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+        mesh=mesh,
+    )
+    for t in trajs:
+        learner.enqueue(t)
+    learner.start()
+    logs = learner.step_once(timeout=120)
+    learner.stop()
+    assert learner.num_steps == K
+    assert np.isfinite(float(logs["total_loss"]))
+    torso_k = learner.params["params"]["torso"]["Dense_0"]["kernel"]
+    assert torso_k.sharding.shard_shape(torso_k.shape) == (4, 4)

@@ -114,6 +114,34 @@ def latest_step(ckpt: str) -> int:
     raise RuntimeError(f"checkpoint-step probe failed 3x: {last_err}")
 
 
+def _meta_path(out: str) -> str:
+    return os.path.join(out, "harness_meta.json")
+
+
+def load_meta(out: str) -> dict:
+    """Cross-invocation harness state (cumulative soak wall/kills,
+    baseline wall): the --budget resume path must not forget a completed
+    phase's counters, or a PASSING soak would re-verify as FAIL."""
+    import json
+
+    try:
+        with open(_meta_path(out)) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def save_meta(out: str, **kw) -> None:
+    import json
+
+    meta = load_meta(out)
+    meta.update(kw)
+    tmp = _meta_path(out) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, _meta_path(out))
+
+
 def eval_ckpt(ckpt: str, args) -> float:
     out = subprocess.run(
         [
@@ -149,13 +177,25 @@ def main() -> int:
     p.add_argument("--soak-minutes", type=float, default=60.0)
     p.add_argument("--kill-interval", type=float, default=150.0,
                    help="seconds between SIGKILLs of the training process")
-    p.add_argument("--chaos", type=int, default=4000,
+    # Sized so chaos stays ~1 crash/actor/kill-cycle: each crash costs an
+    # exponential supervisor backoff (0.5s doubling to 30s per consecutive
+    # restart within one process lifetime), so a too-aggressive interval
+    # (4000 was ~10 crashes/actor/cycle here) parks the actors in backoff
+    # and the run crawls at ~15% speed — measured live on this box.
+    p.add_argument("--chaos", type=int, default=25_000,
                    help="each actor env crashes every ~N env steps")
     p.add_argument("--checkpoint-interval", type=int, default=100)
     p.add_argument("--probe-steps", type=int, default=300)
     p.add_argument("--eval-episodes", type=int, default=20)
     p.add_argument("--max-cycles", type=int, default=120,
                    help="hard cap on kill/resume cycles (runaway guard)")
+    p.add_argument("--budget", type=int, default=None,
+                   help="explicit step budget: skips the probe, and any "
+                        "phase whose checkpoint already carries the full "
+                        "budget is skipped too — a killed/retuned soak "
+                        "HARNESS resumes instead of redoing hours of "
+                        "baseline (the training runs were always "
+                        "resumable; this makes the harness match)")
     args = p.parse_args()
 
     os.makedirs(args.out, exist_ok=True)
@@ -166,6 +206,10 @@ def main() -> int:
     # differencing the walls cancels the constant per-process overhead
     # (jax import + compile), which otherwise understates the steady rate
     # ~5x and undersizes the budget (observed on the mini validation run).
+    if args.budget is not None:
+        budget = args.budget
+        log(f"budget given: {budget} steps (probe skipped)")
+        return run_phases(args, budget, t_start)
     probe_dir = os.path.join(args.out, "probe")
     s1, s2 = args.probe_steps, args.probe_steps * 5
     log(f"probe: {s1} then {s2} steps (resumed) to difference out compile")
@@ -196,34 +240,60 @@ def main() -> int:
         f"probe: walls={walls[0]:.0f}s/{walls[1]:.0f}s -> steady "
         f"{rate:.1f} steps/s; budget={budget} steps"
     )
+    return run_phases(args, budget, t_start)
 
+
+def _phase_done(ckpt: str, budget: int) -> bool:
+    try:
+        return latest_step(ckpt) >= budget
+    except RuntimeError:
+        return False
+
+
+def run_phases(args, budget: int, t_start: float) -> int:
     # ---- baseline: uninterrupted, same seed, same budget ----
     base_dir = os.path.join(args.out, "baseline")
-    log(f"baseline: {budget} steps uninterrupted (est "
-        f"{budget / rate / 60:.0f} min)")
-    t0 = time.time()
-    with open(os.path.join(args.out, "baseline.log"), "w") as f:
-        proc = launch(
-            run_cmd(budget, os.path.join(base_dir, "ck"), base_dir, args),
-            f,
-        )
-        rc = proc.wait()
-    base_wall = time.time() - t0
-    if rc != 0:
-        log(f"baseline FAILED rc={rc}")
-        return 1
+    base_wall = None
+    if _phase_done(os.path.join(base_dir, "ck"), budget):
+        base_wall = load_meta(args.out).get("base_wall")
+        log("baseline: already complete at this budget; skipping "
+            f"(recorded wall: {base_wall})")
+    else:
+        log(f"baseline: {budget} steps uninterrupted")
+        t0 = time.time()
+        with open(os.path.join(args.out, "baseline.log"), "a") as f:
+            proc = launch(
+                run_cmd(
+                    budget, os.path.join(base_dir, "ck"), base_dir, args
+                ),
+                f,
+            )
+            rc = proc.wait()
+        base_wall = time.time() - t0
+        if rc != 0:
+            log(f"baseline FAILED rc={rc}")
+            return 1
+        save_meta(args.out, base_wall=base_wall)
     base_step = latest_step(os.path.join(base_dir, "ck"))
-    log(f"baseline: done in {base_wall / 60:.1f} min, "
-        f"final checkpoint step={base_step}")
+    log(f"baseline: complete (final checkpoint step={base_step})")
 
     # ---- soak: chaos + SIGKILL-and-resume until the budget completes ----
     soak_dir = os.path.join(args.out, "soak")
     ck = os.path.join(soak_dir, "ck")
-    kills = 0
+    # Cumulative across harness invocations (--budget resume): a soak
+    # whose phase already completed must keep its kill/duration record.
+    meta = load_meta(args.out)
+    kills = int(meta.get("soak_kills", 0))
+    prior_wall = float(meta.get("soak_wall", 0.0))
     t_soak = time.time()
-    rc = None
-    soak_log = open(os.path.join(args.out, "soak_train.log"), "w")
-    for cycle in range(args.max_cycles):
+    rc = 0 if _phase_done(ck, budget) else None
+    if rc == 0:
+        log(f"soak: already complete at this budget; skipping "
+            f"({kills} kills, {prior_wall / 60:.1f} min recorded)")
+    last_step = -1
+    stagnant = 0
+    soak_log = open(os.path.join(args.out, "soak_train.log"), "a")
+    for cycle in range(args.max_cycles if rc is None else 0):
         proc = launch(
             run_cmd(budget, ck, soak_dir, args, chaos=args.chaos), soak_log
         )
@@ -238,14 +308,38 @@ def main() -> int:
             raise SystemExit(f"soak training crashed on its own: rc={rc}")
         kills += 1
         step_now = latest_step(ck)
+        save_meta(
+            args.out,
+            soak_kills=kills,
+            soak_wall=prior_wall + (time.time() - t_soak),
+        )
         log(f"soak cycle {cycle}: SIGKILLed at step~{step_now}/{budget} "
             f"({elapsed:.1f} min, {kills} kills)")
+        # A kill interval shorter than process startup + the first
+        # checkpoint save makes NO cycle ever advance (observed on a
+        # mini run with an 18s interval) — fail fast with the cause
+        # instead of spinning silently to max-cycles.
+        if step_now <= last_step:
+            stagnant += 1
+            if stagnant >= 5:
+                soak_log.close()
+                raise SystemExit(
+                    f"soak made no checkpoint progress for {stagnant} "
+                    f"consecutive cycles (stuck at step {step_now}): "
+                    f"--kill-interval {args.kill_interval:.0f}s is likely "
+                    "shorter than process startup + the first "
+                    "--checkpoint-interval save"
+                )
+        else:
+            stagnant = 0
+        last_step = step_now
         if step_now >= budget:
             # Killed between final checkpoint and exit; one clean lap to
             # let the run terminate normally.
             continue
     soak_log.close()
-    soak_wall = time.time() - t_soak
+    soak_wall = prior_wall + (time.time() - t_soak)
+    save_meta(args.out, soak_kills=kills, soak_wall=soak_wall)
     if rc != 0:
         log("soak never completed inside max-cycles")
         return 1
@@ -274,7 +368,7 @@ this box's TPU tunnel wedges if a process holding TPU buffers is killed).
 |---|---|---|
 | budget (learner steps) | {budget} | {budget} |
 | final checkpoint step | {base_step} | {soak_step} |
-| wall clock | {base_wall / 60:.1f} min | {soak_wall / 60:.1f} min |
+| wall clock | {f"{base_wall / 60:.1f} min" if base_wall else "n/a (prior invocation, wall not recorded)"} | {soak_wall / 60:.1f} min |
 | SIGKILLs of the whole process | 0 | {kills} |
 | env chaos | off | every ~{args.chaos} env steps/actor |
 | greedy eval ({args.eval_episodes} eps, cap 500) | {base_eval:.1f} | {soak_eval:.1f} |
