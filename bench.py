@@ -379,11 +379,15 @@ def run_bench(jax, tpu_ok: bool) -> dict:
             result["mfu_estimate"] = round((flops * steps / dt) / 197e12, 4)
     if not tpu_ok:
         result["note"] = (
-            "TPU tunnel unreachable at bench time; CPU fallback number — "
-            "not comparable to the 62.5k/chip TPU yardstick. Real-chip "
-            "numbers captured during the round are committed in "
-            "BENCH_live.json (502k frames/s/chip, vs_baseline 8.04) with "
-            "the profiler trace under traces/bench/."
+            "TPU tunnel unreachable at bench time (wedged machine-wide "
+            "for the whole of round 3 — tunnel_watch.log records 10+ "
+            "hours of failed bounded probes); CPU fallback number — not "
+            "comparable to the 62.5k/chip TPU yardstick. Latest real-chip "
+            "evidence is committed in BENCH_live.json (502k learner "
+            "frames/s/chip, vs_baseline 8.04, captured 2026-07-29) with "
+            "the profiler trace under traces/bench/; tunnel_watch.sh + "
+            "tools/tunnel_watch_respawn.sh auto-capture and commit a "
+            "fresh full-section run the moment the tunnel heals."
         )
     log(
         f"bench: {steps} steps in {dt:.3f}s -> {frames_per_sec:,.0f} frames/s "
