@@ -237,3 +237,15 @@ def test_tensor_parallel_composes_with_fused_dispatch():
     assert np.isfinite(float(logs["total_loss"]))
     torso_k = learner.params["params"]["torso"]["Dense_0"]["kernel"]
     assert torso_k.sharding.shard_shape(torso_k.shape) == (4, 4)
+
+
+def test_model_shardings_on_mesh_without_model_axis():
+    """Regression: a mesh with NO 'model' axis (the ('data','seq') DP+SP
+    mesh) must yield fully-replicated shardings, not a KeyError — the
+    Learner calls model_shardings for EVERY mesh it is given."""
+    from torched_impala_tpu.parallel import data_seq_mesh, model_shardings
+
+    mesh = data_seq_mesh(2, 4)
+    tree = {"w": jnp.zeros((4, 16)), "b": jnp.zeros((16,))}
+    sh = model_shardings(mesh, tree)
+    assert all(s.is_fully_replicated for s in jax.tree.leaves(sh))

@@ -74,7 +74,10 @@ def model_shardings(mesh: Mesh, tree):
     step in tests/test_parallel.py. With a size-1 model axis everything
     replicates (the DP-only layout, unchanged).
     """
-    n = mesh.shape[MODEL_AXIS]
+    # Meshes without a 'model' axis at all (e.g. the ('data','seq') DP+SP
+    # mesh) replicate exactly like a size-1 model axis — caught by the
+    # full-suite DP+SP tests when this indexed unconditionally.
+    n = dict(mesh.shape).get(MODEL_AXIS, 1)
 
     def rule(leaf):
         shape = getattr(leaf, "shape", ())
