@@ -899,7 +899,7 @@ def run_attention_kernel_compare(jax) -> dict:
     the transformer core's actual shapes (pong_transformer preset: H=4,
     dh=64, W=128; learner re-forwards T = unroll+1 = 21). Checks compiled
     equivalence, then times forward and forward+backward (the custom-VJP
-    recompute backward vs XLA's einsum backward)."""
+    Pallas recompute-backward kernel vs XLA's einsum backward)."""
     import jax.numpy as jnp
     import numpy as np
 

@@ -17,8 +17,12 @@ which is exactly the dense path's `concat(cache_vis, intra_vis)` mask
 Gradients: attention sits in the learner's loss path, so the op carries a
 custom VJP. The backward pass RECOMPUTES probabilities from the saved
 q/k/v (flash-attention's standard rematerialization trade: ~1 extra
-matmul instead of storing `[B, H, T, S]` probs between passes) and runs
-the classic softmax-attention backward in plain XLA einsums.
+matmul instead of storing `[B, H, T, S]` probs between passes). It too
+is a fused Pallas kernel — one program per (batch row, head) computes
+P, dP, the softmax-Jacobian contraction, and all three input gradients
+with nothing but the O(T+S) inputs/outputs touching HBM — with an
+einsum fallback when the score tile exceeds the kernel's VMEM budget
+(`_BWD_VMEM_LIMIT`; the size check is the only dispatch criterion).
 
 Used by models/transformer.py when `dense_kernel="pallas"` (resolved from
 'auto' against the compute devices in configs.make_agent, like the
@@ -40,6 +44,34 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 _PAD_SEG = -2_147_483_000  # matches no real segment id (kv empty is -1)
+
+
+def _visible_tile(seg_q, seg_c, t_offset, Tb: int, S: int, W: int):
+    """The visibility mask both kernels share (THE correctness-critical
+    invariant: cache slot or causal in-unroll, same episode). seg_q
+    `[Tb]`, seg_c `[S]`; t_offset is the query block's absolute start."""
+    tq = t_offset + jax.lax.broadcasted_iota(jnp.int32, (Tb, S), 0)
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (Tb, S), 1)
+    return (seg_q[:, None] == seg_c[None, :]) & (
+        (s_idx < W) | (s_idx - W <= tq)
+    )
+
+
+def _pad_segs(seg_q, seg_ctx, Tp: int, Sp: int):
+    """Shared sentinel padding: padded query rows get a sentinel that
+    matches nothing real; padded context slots a DIFFERENT sentinel so
+    the two can't match each other either."""
+    T, S = seg_q.shape[1], seg_ctx.shape[1]
+    return (
+        jnp.pad(
+            seg_q.astype(jnp.int32), ((0, 0), (0, Tp - T)),
+            constant_values=_PAD_SEG + 1,
+        ),
+        jnp.pad(
+            seg_ctx.astype(jnp.int32), ((0, 0), (0, Sp - S)),
+            constant_values=_PAD_SEG,
+        ),
+    )
 
 
 def _attn_kernel(
@@ -71,13 +103,7 @@ def _attn_kernel(
         * scale
     )  # [Tb, S]
 
-    tq = pl.program_id(2) * Tb + jax.lax.broadcasted_iota(
-        jnp.int32, (Tb, S), 0
-    )  # absolute in-unroll query index
-    s_idx = jax.lax.broadcasted_iota(jnp.int32, (Tb, S), 1)
-    visible = (seg_q[:, None] == seg_c[None, :]) & (
-        (s_idx < W) | (s_idx - W <= tq)
-    )
+    visible = _visible_tile(seg_q, seg_c, pl.program_id(2) * Tb, Tb, S, W)
     logits = jnp.where(visible, logits, NEG_INF)
 
     m = jnp.max(logits, axis=-1, keepdims=True)
@@ -112,16 +138,7 @@ def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
     qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
     kp = jnp.pad(k_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
     vp = jnp.pad(v_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
-    segq_p = jnp.pad(
-        seg_q.astype(jnp.int32),
-        ((0, 0), (0, Tp - T)),
-        constant_values=_PAD_SEG + 1,
-    )
-    segc_p = jnp.pad(
-        seg_ctx.astype(jnp.int32),
-        ((0, 0), (0, Sp - S)),
-        constant_values=_PAD_SEG,
-    )
+    segq_p, segc_p = _pad_segs(seg_q, seg_ctx, Tp, Sp)
 
     kernel = functools.partial(
         _attn_kernel, scale=1.0 / (dh**0.5), W=W, Tb=Tb, S=Sp
@@ -147,6 +164,102 @@ def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
         interpret=interpret,
     )(qp, kp, vp, segq_p, segc_p)
     return out[:, :T].astype(out_dtype)
+
+
+def _attn_bwd_kernel(
+    q_ref,  # [1, Tp, 1, dh]
+    k_ref,  # [1, Sp, 1, dh]
+    v_ref,  # [1, Sp, 1, dh]
+    g_ref,  # [1, Tp, 1, dh] output cotangent
+    segq_ref,  # [1, Tp] int32
+    segc_ref,  # [1, Sp] int32
+    dq_ref,  # [1, Tp, 1, dh]
+    dk_ref,  # [1, Sp, 1, dh]
+    dv_ref,  # [1, Sp, 1, dh]
+    *,
+    scale: float,
+    W: int,
+    Tp: int,
+    Sp: int,
+):
+    """Classic softmax-attention backward, fused per (batch row, head):
+    recompute P from q/k + segments, then
+      dP = g V^T;  D_i = sum_j P_ij dP_ij;  dS = P * (dP - D);
+      dQ = dS K * scale;  dK = dS^T Q * scale;  dV = P^T g.
+    (D via P*dP avoids needing the forward output.)"""
+    q = q_ref[0, :, 0, :]
+    k = k_ref[0, :, 0, :]
+    v = v_ref[0, :, 0, :]
+    g = g_ref[0, :, 0, :]
+    seg_q = segq_ref[0, :]
+    seg_c = segc_ref[0, :]
+
+    dot = functools.partial(
+        jax.lax.dot_general, preferred_element_type=jnp.float32
+    )
+    logits = dot(q, k, (((1,), (1,)), ((), ()))) * scale  # [Tp, Sp]
+    visible = _visible_tile(seg_q, seg_c, 0, Tp, Sp, W)
+    logits = jnp.where(visible, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+
+    dp = dot(g, v, (((1,), (1,)), ((), ())))  # [Tp, Sp]
+    d = jnp.sum(p * dp, axis=-1, keepdims=True)  # [Tp, 1]
+    ds = p * (dp - d)
+    dq_ref[0, :, 0, :] = dot(ds, k, (((1,), (0,)), ((), ()))) * scale
+    dk_ref[0, :, 0, :] = dot(ds, q, (((0,), (0,)), ((), ()))) * scale
+    dv_ref[0, :, 0, :] = dot(p, g, (((0,), (0,)), ((), ())))
+
+
+# Above this many f32 elements for the [Tp, Sp] score tile, the backward
+# falls back to the einsum path. The single-block-per-(b,h) kernel holds
+# ~5 tile-sized f32 temporaries at once (logits, mask, p, dp, ds) plus
+# the q/k/v/g blocks, so the budget is sized at tile*5*4B ~= 2.6MB —
+# well inside a v5e core's ~16MB VMEM with headroom for double buffering.
+_BWD_VMEM_LIMIT = 128 * 1024
+
+
+def _bwd_pallas(q, k_ctx, v_ctx, g, seg_q, seg_ctx, W, interpret):
+    B, T, H, dh = q.shape
+    S = k_ctx.shape[1]
+    f32 = jnp.float32
+    q, k_ctx, v_ctx, g = (jnp.asarray(x, f32) for x in (q, k_ctx, v_ctx, g))
+    Tp = _round_up(T, 8)
+    Sp = _round_up(S, 128)
+    qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+    gp = jnp.pad(g, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+    kp = jnp.pad(k_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    vp = jnp.pad(v_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    segq_p, segc_p = _pad_segs(seg_q, seg_ctx, Tp, Sp)
+    kernel = functools.partial(
+        _attn_bwd_kernel, scale=1.0 / (dh**0.5), W=W, Tp=Tp, Sp=Sp
+    )
+    t_spec = pl.BlockSpec(
+        (1, Tp, 1, dh), lambda b, h: (b, 0, h, 0), memory_space=pltpu.VMEM
+    )
+    s_spec = pl.BlockSpec(
+        (1, Sp, 1, dh), lambda b, h: (b, 0, h, 0), memory_space=pltpu.VMEM
+    )
+    segq_spec = pl.BlockSpec(
+        (1, Tp), lambda b, h: (b, 0), memory_space=pltpu.VMEM
+    )
+    segc_spec = pl.BlockSpec(
+        (1, Sp), lambda b, h: (b, 0), memory_space=pltpu.VMEM
+    )
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B, H),
+        in_specs=[t_spec, s_spec, s_spec, t_spec, segq_spec, segc_spec],
+        out_specs=(t_spec, s_spec, s_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Tp, H, dh), f32),
+            jax.ShapeDtypeStruct((B, Sp, H, dh), f32),
+            jax.ShapeDtypeStruct((B, Sp, H, dh), f32),
+        ),
+        interpret=interpret,
+    )(qp, kp, vp, gp, segq_p, segc_p)
+    return dq[:, :T], dk[:, :S], dv[:, :S]
 
 
 def _visibility(seg_q, seg_ctx, T: int, S: int, W: int):
@@ -187,12 +300,30 @@ def _bwd(W, interpret, res, g):
     q, k_ctx, v_ctx, seg_q, seg_ctx = res
     B, T, H, dh = q.shape
     S = k_ctx.shape[1]
+    if _round_up(T, 8) * _round_up(S, 128) <= _BWD_VMEM_LIMIT:
+        dq, dk, dv = _bwd_pallas(
+            q, k_ctx, v_ctx, g, seg_q, seg_ctx, W, interpret
+        )
+    else:
+        dq, dk, dv = _bwd_einsum(q, k_ctx, v_ctx, g, seg_q, seg_ctx, W)
+    # Cotangent dtypes must match the primals' (bf16 inputs get bf16
+    # grads even though the math above runs in f32).
+    dq, dk, dv = (
+        d.astype(r.dtype) for d, r in zip((dq, dk, dv), res[:3])
+    )
+    return dq, dk, dv, None, None
+
+
+def _bwd_einsum(q, k_ctx, v_ctx, g, seg_q, seg_ctx, W):
+    """Oversize fallback: recompute P, classic backward in plain einsums
+    (XLA fuses these well; used when the [T, S] tile exceeds the
+    single-block kernel's VMEM budget)."""
+    B, T, H, dh = q.shape
+    S = k_ctx.shape[1]
     f32 = jnp.float32
     q, k_ctx, v_ctx, g = (jnp.asarray(x, f32) for x in (q, k_ctx, v_ctx, g))
     scale = 1.0 / (dh**0.5)
 
-    # Recompute probabilities (rematerialization), then the classic
-    # softmax-attention backward — plain einsums XLA fuses well.
     logits = jnp.einsum("bthd,bshd->bhts", q, k_ctx) * scale
     vis = _visibility(seg_q, seg_ctx, T, S, W)  # [B, T, S]
     logits = jnp.where(vis[:, None, :, :], logits, NEG_INF)
@@ -203,12 +334,7 @@ def _bwd(W, interpret, res, g):
     ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
     dq = jnp.einsum("bhts,bshd->bthd", ds, k_ctx) * scale
     dk = jnp.einsum("bhts,bthd->bshd", ds, q) * scale
-    # Cotangent dtypes must match the primals' (bf16 inputs get bf16
-    # grads even though the math above runs in f32).
-    dq, dk, dv = (
-        d.astype(r.dtype) for d, r in zip((dq, dk, dv), res[:3])
-    )
-    return dq, dk, dv, None, None
+    return dq, dk, dv
 
 
 windowed_attention.defvjp(_fwd, _bwd)
