@@ -164,3 +164,83 @@ class TestSweepBookkeeping:
         with pytest.raises(SystemExit, match="ale-py"):
             sweep.main(["--games", "Pong", "--out", str(out),
                         "--workdir", str(tmp_path / "w")])
+
+
+class TestSummarize:
+    def test_summary_table_counts_and_hns(self, tmp_path, capsys):
+        """--summarize digests a partially-complete sweep: done rows with
+        returns (+ human-normalized scores when a norm table is given),
+        error rows surfaced, everything else pending; the reference's
+        Atari-57 aggregate (median HNS) computed over covered games."""
+        import json
+
+        out = tmp_path / "s.csv"
+        out.write_text(
+            "game,env_id,train_rc,eval_rc,mean_return,error\n"
+            "Pong,PongNoFrameskip-v4,0,0,19.5,\n"
+            "Breakout,BreakoutNoFrameskip-v4,0,0,200.0,\n"
+            "Alien,AlienNoFrameskip-v4,1,,,boom\n"
+        )
+        norms = tmp_path / "norms.json"
+        norms.write_text(json.dumps({
+            "Pong": [-20.7, 14.6], "Breakout": [1.7, 30.5],
+        }))
+        rc = sweep.main([
+            "--summarize", "--out", str(out),
+            "--games", "Pong", "Breakout", "Alien", "Seaquest",
+            "--norm-scores", str(norms),
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "2/4 done, 1 error, 1 pending" in text
+        # Pong HNS = (19.5+20.7)/(14.6+20.7) ~= 1.139; Breakout ~= 6.885.
+        assert "hns=  1.139" in text
+        assert "hns=  6.885" in text
+        assert "median 4.012" in text
+        assert "ERROR" in text and "boom" in text
+        assert "pending" in text
+
+    def test_summary_without_norms(self, tmp_path, capsys):
+        out = tmp_path / "s.csv"
+        out.write_text(
+            "game,env_id,train_rc,eval_rc,mean_return,error\n"
+            "Pong,PongNoFrameskip-v4,0,0,19.5,\n"
+        )
+        rc = sweep.main(
+            ["--summarize", "--out", str(out), "--games", "Pong"]
+        )
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "1/1 done" in text
+        assert "hns" not in text
+
+    def test_summary_subset_counts_and_nan_exclusion(self, tmp_path, capsys):
+        """--games subsets count only selected games, and a recorded nan
+        return is excluded from (not poisoning) the HNS aggregate."""
+        import json
+
+        out = tmp_path / "s.csv"
+        out.write_text(
+            "game,env_id,train_rc,eval_rc,mean_return,error\n"
+            "Pong,PongNoFrameskip-v4,0,0,19.5,\n"
+            "Breakout,BreakoutNoFrameskip-v4,0,0,nan,\n"
+        )
+        norms = tmp_path / "n.json"
+        norms.write_text(json.dumps({
+            "Pong": [-20.7, 14.6], "Breakout": [1.7, 30.5],
+        }))
+        rc = sweep.main([
+            "--summarize", "--out", str(out), "--games", "Pong",
+            "--norm-scores", str(norms),
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "1/1 done" in text  # Breakout's row doesn't inflate counts
+        rc = sweep.main([
+            "--summarize", "--out", str(out),
+            "--games", "Pong", "Breakout", "--norm-scores", str(norms),
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "non-finite; excluded" in text
+        assert "median 1.139" in text  # Pong only; nan kept out

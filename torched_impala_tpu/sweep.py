@@ -69,6 +69,15 @@ def parse_args(argv=None):
     p.add_argument("--fake-envs", action="store_true",
                    help="shape-faithful fake envs instead of ALE (dry-run "
                         "the sweep pipeline on an emulator-less host)")
+    p.add_argument("--summarize", action="store_true",
+                   help="print a summary of --out instead of running: "
+                        "per-game returns, completion/error counts, and "
+                        "(with --norm-scores) human-normalized aggregates")
+    p.add_argument("--norm-scores", default=None, metavar="JSON",
+                   help="path to {game: [random_score, human_score]} for "
+                        "human-normalized scoring (the published "
+                        "Mnih-2015/IMPALA constants; not baked in so the "
+                        "normalization provenance is always explicit)")
     p.add_argument("extra", nargs=argparse.REMAINDER,
                    help="flags after '--' pass through to run.py")
     return p.parse_args(argv)
@@ -182,8 +191,69 @@ def rewrite_results(path: str, rows) -> None:
     os.replace(tmp, path)
 
 
+def summarize(args) -> int:
+    """Digest a results CSV: per-game table, completion/error counts,
+    and — when a {game: [random, human]} table is supplied — the
+    human-normalized scores the reference's Atari-57 protocol aggregates
+    (HNS = (score - random) / (human - random); median/mean over games
+    with both a recorded return and normalization constants)."""
+    done, diag = load_prior_rows(args.out)
+    games = args.games or ATARI_57
+    norms = {}
+    if args.norm_scores:
+        import json
+
+        with open(args.norm_scores) as f:
+            norms = json.load(f)
+    import math
+
+    rows = []
+    hns = {}
+    for game in games:
+        if game in done:
+            ret = float(done[game]["mean_return"])
+            extra = ""
+            # Non-finite returns are recorded (so the game isn't re-run
+            # forever) but must not poison the HNS aggregate — nan breaks
+            # statistics.median's sort silently.
+            if not math.isfinite(ret):
+                extra = "  (non-finite; excluded from aggregates)"
+            elif game in norms:
+                rand, human = float(norms[game][0]), float(norms[game][1])
+                if human != rand:
+                    hns[game] = (ret - rand) / (human - rand)
+                    extra = f"  hns={hns[game]:7.3f}"
+            rows.append(f"  {game:<20} {ret:12.1f}{extra}")
+        elif game in diag:
+            err = (diag[game].get("error") or "?")[:50]
+            rows.append(f"  {game:<20} {'ERROR':>12}  {err}")
+        else:
+            rows.append(f"  {game:<20} {'pending':>12}")
+    print("\n".join(rows))
+    print(
+        f"{sum(1 for g in games if g in done)}/{len(games)} done, "
+        f"{sum(1 for g in games if g in diag)} error, "
+        f"{sum(1 for g in games if g not in done and g not in diag)} "
+        "pending"
+    )
+    if hns:
+        import statistics
+
+        print(
+            f"human-normalized ({len(hns)} games): "
+            f"median {statistics.median(hns.values()):.3f}, "
+            f"mean {statistics.mean(hns.values()):.3f}"
+        )
+    elif args.norm_scores:
+        print("human-normalized: no games with both a return and "
+              "normalization constants")
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.summarize:
+        return summarize(args)
     if not args.fake_envs:
         require_ale()
     games = args.games or ATARI_57
