@@ -448,3 +448,94 @@ class TestSweep:
         done = sweep.load_done_rows(str(out))
         assert set(done) == {"Pong"}  # error row (no return) is retried
         assert float(done["Pong"]["mean_return"]) == 19.5
+
+
+class TestBatchedEvaluator:
+    def test_matches_deterministic_env_stats_and_cap(self):
+        """8 episodes across 3 lockstep envs on a deterministic env must
+        yield the serial runner's per-episode stats (episode_len 6,
+        return 6.0 each); the step cap truncates like the serial path."""
+        import jax
+        import jax.numpy as jnp
+
+        from torched_impala_tpu.envs.fake import FakeDiscreteEnv, ScriptedEnv
+        from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+        from torched_impala_tpu.runtime import run_episodes_batched
+
+        agent = Agent(
+            ImpalaNet(num_actions=2, torso=MLPTorso(hidden_sizes=(16,)))
+        )
+        params = agent.init_params(
+            jax.random.key(0), jnp.zeros((4,), jnp.float32)
+        )
+        result = run_episodes_batched(
+            agent=agent,
+            params=params,
+            env_factory=lambda s: ScriptedEnv(episode_len=6),
+            num_episodes=8,
+            parallel_envs=3,
+            greedy=True,
+        )
+        assert result.returns == [6.0] * 8
+        assert result.lengths == [6] * 8
+
+        # Cap semantics: a long env truncates at max_steps_per_episode.
+        capped = run_episodes_batched(
+            agent=agent,
+            params=params,
+            env_factory=lambda s: FakeDiscreteEnv(
+                obs_shape=(4,), num_actions=2, episode_len=1000, seed=s
+            ),
+            num_episodes=4,
+            parallel_envs=2,
+            greedy=True,
+            max_steps_per_episode=9,
+        )
+        assert capped.lengths == [9] * 4
+
+    def test_lstm_state_resets_between_episodes(self):
+        """Recurrent eval: first=True on auto-reset must reset that row's
+        carry (reset-core semantics), so per-episode stats stay identical
+        across a fleet with staggered episode boundaries."""
+        import jax
+        import jax.numpy as jnp
+
+        from torched_impala_tpu.envs.fake import ScriptedEnv
+        from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+        from torched_impala_tpu.runtime import run_episodes_batched
+
+        agent = Agent(
+            ImpalaNet(
+                num_actions=2, torso=MLPTorso(hidden_sizes=(8,)),
+                use_lstm=True, lstm_size=8,
+            )
+        )
+        params = agent.init_params(
+            jax.random.key(0), jnp.zeros((4,), jnp.float32)
+        )
+        result = run_episodes_batched(
+            agent=agent,
+            params=params,
+            env_factory=lambda s: ScriptedEnv(episode_len=5),
+            num_episodes=6,
+            parallel_envs=2,
+            greedy=True,
+        )
+        assert result.lengths == [5] * 6
+
+    def test_cli_eval_parallel(self, tmp_path):
+        """--eval-parallel through the product CLI: train a couple of
+        steps, then batched-eval the checkpoint."""
+        ck = str(tmp_path / "ck")
+        assert cli_main([
+            "--config", "cartpole", "--platform", "cpu",
+            "--total-steps", "2", "--num-actors", "1",
+            "--envs-per-actor", "1", "--batch-size", "2",
+            "--logger", "null", "--checkpoint-dir", ck,
+        ]) == 0
+        assert cli_main([
+            "--config", "cartpole", "--platform", "cpu",
+            "--mode", "eval", "--checkpoint-dir", ck,
+            "--eval-episodes", "6", "--eval-parallel", "3",
+            "--eval-max-steps", "100",
+        ]) == 0

@@ -113,6 +113,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--eval-max-steps", type=int, default=108_000,
                    help="per-episode env-step cap during eval (guards "
                         "against never-terminating policies); <=0 disables")
+    p.add_argument("--eval-parallel", type=int, default=1, metavar="E",
+                   help="step E eval envs in lockstep with one batched "
+                        "policy dispatch per timestep (E-fold fewer "
+                        "dispatches; episode seeding differs from the "
+                        "serial protocol — see runtime/evaluator.py)")
     # Profiling (SURVEY.md §6 tracing row).
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the learner loop")
@@ -533,18 +538,35 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
             )
 
     env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
-    env = env_factory(args.seed + 777_000)
-    result = run_episodes(
-        agent=agent,
-        params=params,
-        env=env,
-        num_episodes=args.eval_episodes,
-        greedy=not args.eval_stochastic,
-        seed=args.seed,
-        max_steps_per_episode=(
-            args.eval_max_steps if args.eval_max_steps > 0 else None
-        ),
-    )
+    max_steps = args.eval_max_steps if args.eval_max_steps > 0 else None
+    if args.eval_parallel > 1:
+        from torched_impala_tpu.runtime.evaluator import (
+            run_episodes_batched,
+        )
+
+        # Factory passed straight through: the evaluator forwards each
+        # env's slot index, so multi-task presets cover tasks 0..E-1.
+        result = run_episodes_batched(
+            agent=agent,
+            params=params,
+            env_factory=env_factory,
+            num_episodes=args.eval_episodes,
+            parallel_envs=args.eval_parallel,
+            greedy=not args.eval_stochastic,
+            seed=args.seed + 777_000,
+            max_steps_per_episode=max_steps,
+        )
+    else:
+        env = env_factory(args.seed + 777_000)
+        result = run_episodes(
+            agent=agent,
+            params=params,
+            env=env,
+            num_episodes=args.eval_episodes,
+            greedy=not args.eval_stochastic,
+            seed=args.seed,
+            max_steps_per_episode=max_steps,
+        )
     print(
         f"eval: episodes={len(result.returns)} "
         f"mean_return={result.mean_return:.2f} "

@@ -11,6 +11,7 @@ from torched_impala_tpu.runtime.env_pool import (  # noqa: F401
 from torched_impala_tpu.runtime.evaluator import (  # noqa: F401
     EvalResult,
     run_episodes,
+    run_episodes_batched,
 )
 from torched_impala_tpu.runtime.learner import (  # noqa: F401
     Learner,
@@ -37,6 +38,7 @@ __all__ = [
     "AnakinRunner",
     "EvalResult",
     "run_episodes",
+    "run_episodes_batched",
     "Learner",
     "LearnerConfig",
     "ParamStore",
