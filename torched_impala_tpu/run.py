@@ -558,15 +558,20 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
         )
     else:
         env = env_factory(args.seed + 777_000)
-        result = run_episodes(
-            agent=agent,
-            params=params,
-            env=env,
-            num_episodes=args.eval_episodes,
-            greedy=not args.eval_stochastic,
-            seed=args.seed,
-            max_steps_per_episode=max_steps,
-        )
+        try:
+            result = run_episodes(
+                agent=agent,
+                params=params,
+                env=env,
+                num_episodes=args.eval_episodes,
+                greedy=not args.eval_stochastic,
+                seed=args.seed,
+                max_steps_per_episode=max_steps,
+            )
+        finally:
+            close = getattr(env, "close", None)
+            if close is not None:
+                close()
     print(
         f"eval: episodes={len(result.returns)} "
         f"mean_return={result.mean_return:.2f} "

@@ -43,6 +43,7 @@ from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.ops import vtrace as vtrace_ops
 from torched_impala_tpu.ops.losses import ImpalaLossConfig, impala_loss
 from torched_impala_tpu.parallel.mesh import (
+    model_shardings,
     DATA_AXIS,
     replicated,
     state_sharding,
@@ -145,8 +146,15 @@ class AnakinRunner:
                 jax.tree.map(lambda _: ss, self._carry[3]),
                 ss,
             )
-            self.params = jax.device_put(self.params, rep)
-            self.opt_state = jax.device_put(self.opt_state, rep)
+            # Tensor-parallel when the mesh has a model axis wider than 1
+            # (same Megatron-column layout as the Learner); degenerates to
+            # replicated otherwise.
+            self._param_shardings = model_shardings(mesh, self.params)
+            self._opt_shardings = model_shardings(mesh, self.opt_state)
+            self.params = jax.device_put(self.params, self._param_shardings)
+            self.opt_state = jax.device_put(
+                self.opt_state, self._opt_shardings
+            )
             self._carry = jax.tree.map(
                 lambda x, s: jax.device_put(x, s),
                 self._carry,
@@ -156,8 +164,17 @@ class AnakinRunner:
             self._step_fn = jax.jit(
                 step_impl,
                 donate_argnums=(0, 1, 2),
-                in_shardings=(rep, rep, carry_shardings),
-                out_shardings=(rep, rep, carry_shardings, rep),
+                in_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings,
+                    carry_shardings,
+                ),
+                out_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings,
+                    carry_shardings,
+                    rep,
+                ),
             )
 
     @property
@@ -191,13 +208,22 @@ class AnakinRunner:
     def set_state(self, state: Mapping[str, Any]) -> None:
         from torched_impala_tpu.utils.checkpoint import unpack_rng
 
-        put = (
-            (lambda x: jax.device_put(x, replicated(self._mesh)))
-            if self._mesh is not None
-            else (lambda x: x)
-        )
-        self.params = put(state["params"])
-        self.opt_state = put(state["opt_state"])
+        if self._mesh is not None:
+            # Same layouts as construction (TP leaves land back on their
+            # shards; DP-only meshes replicate).
+            self.params = jax.device_put(
+                state["params"], self._param_shardings
+            )
+            self.opt_state = jax.device_put(
+                state["opt_state"], self._opt_shardings
+            )
+            put = lambda x: jax.device_put(  # noqa: E731
+                x, replicated(self._mesh)
+            )
+        else:
+            put = lambda x: x  # noqa: E731
+            self.params = state["params"]
+            self.opt_state = state["opt_state"]
         self.num_frames = int(state["num_frames"])
         self.num_steps = int(state["num_steps"])
         self._carry = (put(unpack_rng(state["rng"])),) + self._carry[1:]

@@ -130,6 +130,11 @@ def run_episodes_batched(
     """
     from torched_impala_tpu.envs.factory import call_env_factory
 
+    if parallel_envs < 1 or num_episodes < 1:
+        raise ValueError(
+            f"need parallel_envs >= 1 and num_episodes >= 1, got "
+            f"{parallel_envs} and {num_episodes}"
+        )
     E = min(parallel_envs, num_episodes)
     envs = [call_env_factory(env_factory, seed + i, i) for i in range(E)]
     try:
