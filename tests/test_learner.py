@@ -680,20 +680,22 @@ class TestGradAccum:
                 example_obs=np.zeros((4,), np.float32),
                 rng=jax.random.key(0),
             )
-        with pytest.raises(ValueError, match="PopArt"):
-            Learner(
-                agent=Agent(
-                    ImpalaNet(
-                        num_actions=2,
-                        torso=MLPTorso(hidden_sizes=(16,)),
-                        num_values=2,
-                    )
-                ),
-                optimizer=optax.sgd(1e-2),
-                config=LearnerConfig(
-                    batch_size=8, unroll_length=4, grad_accum=2,
-                    popart=PopArtConfig(num_values=2),
-                ),
-                example_obs=np.zeros((4,), np.float32),
-                rng=jax.random.key(0),
-            )
+        # PopArt x grad_accum is SUPPORTED (batch-end statistics update;
+        # parity pinned in tests/test_popart.py::TestGradAccumPopArt) —
+        # construction must succeed.
+        Learner(
+            agent=Agent(
+                ImpalaNet(
+                    num_actions=2,
+                    torso=MLPTorso(hidden_sizes=(16,)),
+                    num_values=2,
+                )
+            ),
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=8, unroll_length=4, grad_accum=2,
+                popart=PopArtConfig(num_values=2),
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+        )

@@ -356,6 +356,125 @@ def test_popart_fused_dispatch_matches_sequential():
 
 
 
+class TestGradAccumPopArt:
+    """grad_accum composes with PopArt via the batch-end statistics update
+    (VERDICT r3 item 4): params AND (mu, nu) after one accumulated step
+    must equal the unaccumulated full-batch step, for feedforward, LSTM,
+    and the DP mesh — unblocking the DMLab-30 preset's HBM lever."""
+
+    B, T, NUM_TASKS = 8, 4, 2
+
+    def _collect(self, use_lstm):
+        from torched_impala_tpu.envs.fake import FakeDiscreteEnv
+        from torched_impala_tpu.runtime import Actor, ParamStore
+
+        agent = self._agent(use_lstm)
+        params = agent.init_params(jax.random.key(0), jnp.zeros((8,)))
+        store = ParamStore()
+        store.publish(0, params)
+        trajs = []
+        for i in range(self.B):
+            actor = Actor(
+                actor_id=i,
+                env=FakeDiscreteEnv(
+                    obs_shape=(8,), num_actions=3, episode_len=7,
+                    reward_scale=5.0 ** (i % self.NUM_TASKS), seed=i,
+                ),
+                agent=agent,
+                param_store=store,
+                enqueue=lambda t: None,
+                unroll_length=self.T,
+                seed=i,
+                task=i % self.NUM_TASKS,
+            )
+            trajs.append(actor.unroll(params))
+        return trajs
+
+    def _agent(self, use_lstm):
+        from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+
+        return Agent(
+            ImpalaNet(
+                num_actions=3,
+                torso=MLPTorso(hidden_sizes=(16,)),
+                num_values=self.NUM_TASKS,
+                use_lstm=use_lstm,
+                lstm_size=8,
+            )
+        )
+
+    def _step(self, trajs, G, use_lstm=False, mesh=None):
+        from torched_impala_tpu.runtime import Learner, LearnerConfig
+
+        learner = Learner(
+            agent=self._agent(use_lstm),
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=self.B,
+                unroll_length=self.T,
+                grad_accum=G,
+                popart=PopArtConfig(
+                    num_values=self.NUM_TASKS, step_size=0.1
+                ),
+            ),
+            example_obs=np.zeros((8,), np.float32),
+            rng=jax.random.key(0),
+            mesh=mesh,
+        )
+        for t in trajs:
+            learner.enqueue(t)
+        learner.start()
+        try:
+            learner.step_once(timeout=300)
+        finally:
+            learner.stop()
+        return learner
+
+    @pytest.mark.parametrize("use_lstm", [False, True])
+    def test_matches_full_batch(self, use_lstm):
+        trajs = self._collect(use_lstm)
+        full = self._step(list(trajs), 1, use_lstm)
+        acc = self._step(list(trajs), 4, use_lstm)
+        np.testing.assert_allclose(
+            np.asarray(full.popart_state.mu),
+            np.asarray(acc.popart_state.mu),
+            rtol=1e-6, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(full.popart_state.nu),
+            np.asarray(acc.popart_state.nu),
+            rtol=1e-6, atol=1e-8,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            full.params,
+            acc.params,
+        )
+
+    def test_matches_full_batch_on_dp_mesh(self):
+        from torched_impala_tpu.parallel import make_mesh
+
+        trajs = self._collect(False)
+        full = self._step(list(trajs), 1)
+        acc = self._step(
+            list(trajs), 2, mesh=make_mesh(num_data=4)
+        )
+        np.testing.assert_allclose(
+            np.asarray(full.popart_state.mu),
+            np.asarray(acc.popart_state.mu),
+            rtol=1e-5, atol=1e-7,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            full.params,
+            acc.params,
+        )
+
+
 def test_multitask_popart_learns_both_scales_end_to_end():
     """DMLab-30-preset-shaped claim (VERDICT r2 item 6): two tasks whose
     reward scales differ 100x, DIFFERENT per-task action mappings, trained

@@ -99,18 +99,18 @@ def unnormalize(
     return sigma(state, config)[tasks] * x + state.mu[tasks]
 
 
-def update(
-    state: PopArtState,
+def batch_moments(
     config: PopArtConfig,
     targets: jax.Array,  # [T, B] unnormalized value targets (vs)
     tasks: jax.Array,  # [B] int32 task id per batch element
     mask: jax.Array,  # [T, B] validity mask
-) -> PopArtState:
-    """One EMA step of (mu, nu) towards the batch's per-task target moments.
-
-    Tasks with no valid samples in the batch keep their statistics. The
-    scatter-add over task ids is the multi-task reduction; XLA turns it into
-    a psum when `tasks`/`targets` are sharded over the data axis.
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-task (count, sum, sum-of-squares) of the batch's targets, each
+    `[num_values]`. ADDITIVE across sub-batches: summing the moments of G
+    microbatches gives exactly the full batch's moments — the property
+    the gradient-accumulation path's batch-end statistics update rests on.
+    The scatter-add over task ids is the multi-task reduction; XLA turns
+    it into a psum when `tasks`/`targets` are sharded over the data axis.
     """
     mask = mask.astype(targets.dtype)
     per_env_cnt = jnp.sum(mask, axis=0)  # [B]
@@ -121,7 +121,18 @@ def update(
     cnt = jnp.zeros((n,), targets.dtype).at[tasks].add(per_env_cnt)
     tot = jnp.zeros((n,), targets.dtype).at[tasks].add(per_env_sum)
     tot_sq = jnp.zeros((n,), targets.dtype).at[tasks].add(per_env_sq)
+    return cnt, tot, tot_sq
 
+
+def apply_moments(
+    state: PopArtState,
+    config: PopArtConfig,
+    cnt: jax.Array,
+    tot: jax.Array,
+    tot_sq: jax.Array,
+) -> PopArtState:
+    """ONE EMA step of (mu, nu) towards the moments' per-task means.
+    Tasks with no valid samples keep their statistics."""
     present = cnt > 0
     denom = jnp.maximum(cnt, 1.0)
     batch_mu = tot / denom
@@ -131,6 +142,20 @@ def update(
     mu = jnp.where(present, state.mu + b * (batch_mu - state.mu), state.mu)
     nu = jnp.where(present, state.nu + b * (batch_nu - state.nu), state.nu)
     return PopArtState(mu=mu, nu=nu)
+
+
+def update(
+    state: PopArtState,
+    config: PopArtConfig,
+    targets: jax.Array,  # [T, B] unnormalized value targets (vs)
+    tasks: jax.Array,  # [B] int32 task id per batch element
+    mask: jax.Array,  # [T, B] validity mask
+) -> PopArtState:
+    """One EMA step of (mu, nu) towards the batch's per-task target
+    moments: `apply_moments(batch_moments(...))`."""
+    return apply_moments(
+        state, config, *batch_moments(config, targets, tasks, mask)
+    )
 
 
 def rescale_head(
@@ -175,6 +200,90 @@ def rescale_params(
     return dict(params, params=new_inner)
 
 
+def _unnormalized_vtrace(
+    *,
+    target_logits,
+    behaviour_logits,
+    norm_values,
+    norm_bootstrap,
+    actions,
+    rewards,
+    discounts,
+    tasks,
+    state: PopArtState,
+    popart_config: PopArtConfig,
+    config: ImpalaLossConfig,
+    devices,
+):
+    """V-trace in unnormalized space under the PRE-update stats (stop-grad:
+    targets are constants). Shared by the loss and the gradient-
+    accumulation stats pass."""
+    s_old = sigma(state, popart_config)[tasks]  # [B]
+    mu_old = state.mu[tasks]
+    values_un = s_old * jax.lax.stop_gradient(norm_values) + mu_old
+    boot_un = s_old * jax.lax.stop_gradient(norm_bootstrap) + mu_old
+    log_rhos = action_log_probs(target_logits, actions) - action_log_probs(
+        behaviour_logits, actions
+    )
+    return _vtrace(
+        log_rhos=log_rhos,
+        discounts=discounts,
+        rewards=rewards,
+        values=values_un,
+        bootstrap_value=boot_un,
+        clip_rho_threshold=config.clip_rho_threshold,
+        clip_c_threshold=config.clip_c_threshold,
+        clip_pg_rho_threshold=config.clip_pg_rho_threshold,
+        lambda_=config.lambda_,
+        implementation=config.vtrace_implementation,
+        devices=devices,
+    )
+
+
+def popart_target_moments(
+    *,
+    target_logits: jax.Array,  # [T, B, A]
+    behaviour_logits: jax.Array,  # [T, B, A]
+    norm_values: jax.Array,  # [T, B]
+    norm_bootstrap: jax.Array,  # [B]
+    actions: jax.Array,  # [T, B]
+    rewards: jax.Array,  # [T, B]
+    discounts: jax.Array,  # [T, B]
+    tasks: jax.Array,  # [B] int32
+    state: PopArtState,
+    popart_config: PopArtConfig,
+    config: ImpalaLossConfig = ImpalaLossConfig(),
+    mask: jax.Array | None = None,
+    devices=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-task (count, sum, sum-of-squares) of one (micro)batch's V-trace
+    targets — the forward-only statistics pass of the gradient-accumulation
+    scheme. Summing these across the G microbatches and calling
+    `apply_moments` ONCE reproduces exactly the full batch's `update`,
+    because the moments are additive and the EMA is applied once either
+    way. The later gradient pass then runs `popart_impala_loss` with
+    `fixed_new_state` set to that result."""
+    if mask is None:
+        mask = jnp.ones_like(rewards)
+    vt = _unnormalized_vtrace(
+        target_logits=target_logits,
+        behaviour_logits=behaviour_logits,
+        norm_values=norm_values,
+        norm_bootstrap=norm_bootstrap,
+        actions=actions,
+        rewards=rewards,
+        discounts=discounts,
+        tasks=tasks,
+        state=state,
+        popart_config=popart_config,
+        config=config,
+        devices=devices,
+    )
+    return batch_moments(
+        popart_config, vt.vs, tasks, mask.astype(vt.vs.dtype)
+    )
+
+
 def popart_impala_loss(
     *,
     target_logits: jax.Array,  # [T, B, A]
@@ -190,6 +299,7 @@ def popart_impala_loss(
     config: ImpalaLossConfig = ImpalaLossConfig(),
     mask: jax.Array | None = None,
     devices=None,
+    fixed_new_state: PopArtState | None = None,
 ) -> tuple[LossOutput, PopArtState]:
     """IMPALA loss with PopArt normalization; returns the updated stats.
 
@@ -198,6 +308,13 @@ def popart_impala_loss(
     outputs stay continuous across the stats move. `devices` resolves
     `config.vtrace_implementation == 'auto'` against the devices this loss
     actually runs on (see `losses.impala_loss`).
+
+    `fixed_new_state`: post-update statistics computed by the caller
+    (gradient accumulation's batch-end scheme: moments accumulated over
+    microbatches via `popart_target_moments`, then `apply_moments` once).
+    When given, the internal per-batch `update` is skipped and the loss is
+    expressed under the SUPPLIED post-update stats, so each microbatch's
+    loss matches the corresponding slice of the full-batch loss exactly.
     """
     if mask is None:
         mask = jnp.ones_like(rewards)
@@ -206,29 +323,27 @@ def popart_impala_loss(
     s_old = sigma(state, popart_config)[tasks]  # [B]
     mu_old = state.mu[tasks]
 
-    # V-trace in unnormalized space (stop-grad: targets are constants).
-    values_un = s_old * jax.lax.stop_gradient(norm_values) + mu_old
-    boot_un = s_old * jax.lax.stop_gradient(norm_bootstrap) + mu_old
-    log_rhos = action_log_probs(target_logits, actions) - action_log_probs(
-        behaviour_logits, actions
-    )
-    vt = _vtrace(
-        log_rhos=log_rhos,
-        discounts=discounts,
+    vt = _unnormalized_vtrace(
+        target_logits=target_logits,
+        behaviour_logits=behaviour_logits,
+        norm_values=norm_values,
+        norm_bootstrap=norm_bootstrap,
+        actions=actions,
         rewards=rewards,
-        values=values_un,
-        bootstrap_value=boot_un,
-        clip_rho_threshold=config.clip_rho_threshold,
-        clip_c_threshold=config.clip_c_threshold,
-        clip_pg_rho_threshold=config.clip_pg_rho_threshold,
-        lambda_=config.lambda_,
-        implementation=config.vtrace_implementation,
+        discounts=discounts,
+        tasks=tasks,
+        state=state,
+        popart_config=popart_config,
+        config=config,
         devices=devices,
     )
 
-    new_state = jax.lax.stop_gradient(
-        update(state, popart_config, vt.vs, tasks, mask)
-    )
+    if fixed_new_state is None:
+        new_state = jax.lax.stop_gradient(
+            update(state, popart_config, vt.vs, tasks, mask)
+        )
+    else:
+        new_state = jax.lax.stop_gradient(fixed_new_state)
     s_new = sigma(new_state, popart_config)[tasks]
     mu_new = new_state.mu[tasks]
 

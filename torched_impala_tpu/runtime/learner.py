@@ -89,8 +89,10 @@ class LearnerConfig:
     # (masks are all-ones on this path), pinned by tests. The HBM lever
     # for batch sizes whose activations don't fit even with remat;
     # composes with steps_per_dispatch (accumulation nests inside each
-    # fused step). Incompatible with PopArt (its stats EMA is not
-    # accumulation-invariant); batch_size must divide by G (and the
+    # fused step). Composes with PopArt via the batch-end statistics
+    # update (moments accumulated over microbatches, ONE EMA application
+    # — exact full-batch stats at the cost of an extra gradient-free
+    # forward per microbatch). batch_size must divide by G (and the
     # per-microbatch batch by the mesh's data axis).
     grad_accum: int = 1
     # Assemble batches with the native (C++) batcher (native/batcher.cpp).
@@ -322,11 +324,6 @@ class Learner:
         if G < 1:
             raise ValueError(f"grad_accum must be >= 1, got {G}")
         if G > 1:
-            if config.popart is not None:
-                raise ValueError(
-                    "grad_accum > 1 is incompatible with PopArt: the "
-                    "per-update stats EMA is not accumulation-invariant"
-                )
             if config.batch_size % G:
                 raise ValueError(
                     f"batch_size {config.batch_size} not divisible by "
@@ -393,15 +390,20 @@ class Learner:
         cont,
         tasks,
         agent_state,
+        fixed_new_popart=None,
     ):
-        """(grads, logs, new_popart_state) for one (micro)batch."""
+        """(grads, logs, new_popart_state) for one (micro)batch.
+
+        `fixed_new_popart`: precomputed post-update PopArt stats (the
+        gradient-accumulation batch-end scheme); forwarded to the loss so
+        every microbatch is expressed under the same full-batch stats."""
         cfg = self._config.loss
         pa_cfg = self._config.popart
 
         def loss_fn(p):
-            net_out, _ = self._agent.unroll(p, obs, first, agent_state)
             discounts = cfg.discount * cont
             if pa_cfg is None:
+                net_out, _ = self._agent.unroll(p, obs, first, agent_state)
                 values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
                 out = impala_loss(
                     target_logits=net_out.policy_logits[:-1],
@@ -414,13 +416,11 @@ class Learner:
                     config=cfg,
                 )
                 return out.total, (out.logs, popart_state)
-            # PopArt: net emits normalized per-task values [T+1, B, K];
-            # select each env's task column, train in normalized space.
-            norm_values = jnp.take_along_axis(
-                net_out.values, tasks[None, :, None], axis=-1
-            )[..., 0]  # [T+1, B]
+            policy_logits, norm_values = self._popart_forward(
+                p, obs, first, agent_state, tasks
+            )
             out, new_pa = popart_ops.popart_impala_loss(
-                target_logits=net_out.policy_logits[:-1],
+                target_logits=policy_logits[:-1],
                 behaviour_logits=behaviour_logits,
                 norm_values=norm_values[:-1],
                 norm_bootstrap=norm_values[-1],
@@ -431,6 +431,7 @@ class Learner:
                 state=popart_state,
                 popart_config=pa_cfg,
                 config=cfg,
+                fixed_new_state=fixed_new_popart,
             )
             return out.total, (out.logs, new_pa)
 
@@ -438,6 +439,17 @@ class Learner:
             loss_fn, has_aux=True
         )(params)
         return grads, logs, new_popart
+
+    def _popart_forward(self, params, obs, first, agent_state, tasks):
+        """(policy_logits, norm_values) with each env's task column
+        selected — the net emits normalized per-task values [T+1, B, K].
+        Shared by the gradient loss and the grad-accum statistics pass so
+        the two can't compute moments from different V-trace targets."""
+        net_out, _ = self._agent.unroll(params, obs, first, agent_state)
+        norm_values = jnp.take_along_axis(
+            net_out.values, tasks[None, :, None], axis=-1
+        )[..., 0]  # [T+1, B]
+        return net_out.policy_logits, norm_values
 
     def _train_step_impl(
         self,
@@ -483,9 +495,55 @@ class Learner:
                 jax.tree.map(split_b, agent_state),
             )
 
+            pa_cfg = self._config.popart
+            if pa_cfg is None:
+                fixed_new = None
+            else:
+                # Batch-end statistics update: the full-batch PopArt loss
+                # expresses every term under the POST-update stats, which
+                # depend on the whole batch's V-trace targets — so an
+                # extra forward-only scan accumulates the per-task target
+                # moments first (they are additive across microbatches),
+                # ONE EMA application reproduces exactly the full-batch
+                # `update`, and the gradient scan below runs under those
+                # fixed stats. Costs one extra (gradient-free) forward
+                # per microbatch — the price of exact full-batch numerics;
+                # activations still peak at one microbatch.
+                def stats_body(carry, xs):
+                    (obs_m, first_m, actions_m, logits_m, rewards_m,
+                     cont_m, tasks_m, astate_m) = xs
+                    policy_logits, norm_values = self._popart_forward(
+                        params, obs_m, first_m, astate_m, tasks_m
+                    )
+                    moments = popart_ops.popart_target_moments(
+                        target_logits=policy_logits[:-1],
+                        behaviour_logits=logits_m,
+                        norm_values=norm_values[:-1],
+                        norm_bootstrap=norm_values[-1],
+                        actions=actions_m,
+                        rewards=rewards_m,
+                        discounts=self._config.loss.discount * cont_m,
+                        tasks=tasks_m,
+                        state=popart_state,
+                        popart_config=pa_cfg,
+                        config=self._config.loss,
+                    )
+                    return jax.tree.map(jnp.add, carry, moments), None
+
+                zero = jnp.zeros((pa_cfg.num_values,), jnp.float32)
+                (cnt, tot, tot_sq), _ = jax.lax.scan(
+                    stats_body, (zero, zero, zero), micro
+                )
+                fixed_new = jax.lax.stop_gradient(
+                    popart_ops.apply_moments(
+                        popart_state, pa_cfg, cnt, tot, tot_sq
+                    )
+                )
+
             def body(acc, xs):
                 g, logs, _ = self._compute_grads(
-                    params, popart_state, *xs
+                    params, popart_state, *xs,
+                    fixed_new_popart=fixed_new,
                 )
                 return jax.tree.map(jnp.add, acc, g), logs
 
@@ -504,7 +562,7 @@ class Learner:
                 else jnp.mean(v, axis=0)
                 for k, v in logs_seq.items()
             }
-            new_popart = popart_state  # PopArt rejected with grad_accum
+            new_popart = popart_state if fixed_new is None else fixed_new
         grad_norm = optax.global_norm(grads)
         if self._config.max_grad_norm is not None:
             scale = jnp.minimum(
