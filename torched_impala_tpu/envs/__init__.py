@@ -11,6 +11,7 @@ from torched_impala_tpu.envs.factory import (  # noqa: F401
 from torched_impala_tpu.envs.jax_envs import (  # noqa: F401
     JaxCartPole,
     JaxCatch,
+    JaxDelayedCue,
     JaxEnvGymWrapper,
     JaxPixelSignal,
 )
@@ -33,6 +34,7 @@ __all__ = [
     "FakeDiscreteEnv",
     "JaxCartPole",
     "JaxCatch",
+    "JaxDelayedCue",
     "JaxEnvGymWrapper",
     "JaxPixelSignal",
     "ScriptedEnv",

@@ -182,6 +182,67 @@ class JaxPixelSignal:
         )
 
 
+class DelayedCueState(NamedTuple):
+    cue: jax.Array  # [] int32: the action that pays at the recall step
+    t: jax.Array  # [] int32 steps taken this episode
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxDelayedCue:
+    """Memory probe: the cue is visible ONLY at t=0; the action taken at
+    the recall step (`delay` steps later, marked by a flag) pays +1 iff it
+    matches the cue. All intermediate observations carry no cue
+    information, so a memoryless policy earns 1/num_actions in expectation
+    at best, while a policy with temporal memory (transformer/LSTM core
+    spanning the delay) earns 1.0 — the discriminative bar
+    tests/test_memory_task.py trains both sides of (SURVEY.md §6
+    long-context row; VERDICT r3 item 7).
+
+    Observation `[num_actions + 2]` f32: one-hot cue (zeros after t=0),
+    episode phase t/(delay+1), and the recall flag (1 at t == delay).
+    Episodes last exactly delay + 1 steps."""
+
+    num_actions: int = 4
+    delay: int = 6
+
+    obs_dtype = jnp.float32
+
+    @property
+    def obs_shape(self) -> tuple:
+        return (self.num_actions + 2,)
+
+    def reset(self, key: jax.Array) -> DelayedCueState:
+        return DelayedCueState(
+            cue=jax.random.randint(key, (), 0, self.num_actions).astype(
+                jnp.int32
+            ),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: DelayedCueState) -> jax.Array:
+        cue_onehot = jnp.where(
+            state.t == 0,
+            jax.nn.one_hot(state.cue, self.num_actions, dtype=jnp.float32),
+            jnp.zeros((self.num_actions,), jnp.float32),
+        )
+        phase = state.t.astype(jnp.float32) / float(self.delay + 1)
+        recall = (state.t == self.delay).astype(jnp.float32)
+        return jnp.concatenate(
+            [cue_onehot, phase[None], recall[None]]
+        )
+
+    def step(
+        self, state: DelayedCueState, action: jax.Array, key: jax.Array
+    ) -> tuple[DelayedCueState, jax.Array, jax.Array]:
+        del key  # deterministic given the reset-time cue
+        at_recall = state.t == self.delay
+        reward = (
+            at_recall & (action.astype(jnp.int32) == state.cue)
+        ).astype(jnp.float32)
+        t = state.t + 1
+        return DelayedCueState(state.cue, t), reward, t > self.delay
+
+
 class JaxEnvGymWrapper:
     """gymnasium-API adapter over any JaxEnv: host-side stepping for the
     eval runner and the host-actor path, so an Anakin-trained policy can be
