@@ -234,9 +234,10 @@ def main(args) -> None:
             lambda: run_bench_anakin_pixels(jax, fast=True),
             gate=tpu_ok,
         )
-        # Stays partial if the alarm skipped anything: the watcher must
-        # not treat a truncated capture as complete.
-        result["partial"] = timed_out
+        # Stays partial if the alarm skipped anything OR the headline
+        # itself errored: the watcher must not promote a capture whose
+        # load-bearing number was never measured.
+        result["partial"] = timed_out or "error" in result
         write_partial()
         print(json.dumps(result))
         return
@@ -263,10 +264,14 @@ def main(args) -> None:
     section("feeder_saturation", lambda: run_feeder_saturation(jax, tpu_ok))
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
-    section("batcher_numpy_vs_native", run_batcher_compare)
-    # Stays partial if the alarm skipped anything: tunnel_watch.sh promotes
-    # only `"partial": false` runs to BENCH_live.json and stops watching.
-    result["partial"] = timed_out
+    section("stack_reuse_compare", run_stack_reuse_compare)
+    # Stays partial if the alarm skipped anything OR the headline errored:
+    # tunnel_watch.sh promotes only `"partial": false` runs to
+    # BENCH_live.json and stops watching, so a capture missing its
+    # load-bearing number must never qualify. (Per-SECTION errors don't
+    # block promotion — section isolation is by design, e.g. an OOM arm
+    # of the remat quadrant.)
+    result["partial"] = timed_out or "error" in result
     write_partial()
     print(json.dumps(result))
 
@@ -821,14 +826,21 @@ def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
 
 
 def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
-    """Host-feed ceiling WITHOUT env stepping (VERDICT r2 item 4): feeder
-    threads replay precomputed per-unroll Trajectories at maximum rate
-    through the REAL Learner ingest path — host queue -> batcher thread
-    stacking B unrolls -> device_put -> bounded device queue -> train
-    step. The resulting frames/s is the max a host like this one can FEED
-    the learner (the e2e sections conflate this with env stepping); on a
-    TPU backend the learner step is fast enough that this number isolates
-    the H2D/batcher bound the 1M-frames/s north star must clear."""
+    """Host-feed ceiling WITHOUT env stepping (VERDICT r2 item 4, r3
+    item 3): feeder threads replay precomputed per-unroll Trajectories at
+    maximum rate through the REAL Learner ingest path — host queue ->
+    batcher thread stacking B unrolls -> device_put -> bounded device
+    queue. Two modes per (B, K) config:
+
+    - drain: batches are pulled straight off the device queue with NO
+      train step — the pure feed-path ceiling, valid on any backend
+      (chip-independent: stacking + device_put are host work). THE number
+      the host-actor architecture stands on: at ~29.7 KB/frame, the
+      62.5k frames/s/chip north-star pace needs ~1.9 GB/s of sustained
+      ingest and the 502k headline ~15 GB/s (see required_* keys).
+    - train: the r2-era mode (feed + real train step + batch_wait_frac),
+      kept on the TPU backend where the step is fast enough to probe
+      whether compute or feed binds first."""
     import threading
 
     import jax.numpy as jnp
@@ -871,7 +883,7 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
         )
     )
 
-    def measure(B: int, K: int, steps: int) -> dict:
+    def measure(B: int, K: int, steps: int, drain_only: bool = False) -> dict:
         learner = Learner(
             agent=Agent(
                 ImpalaNet(
@@ -911,40 +923,83 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
         for th in feeders:
             th.start()
         try:
-            learner.step_once(timeout=600)  # compile + first batch
-            wait0 = learner._wait_accum
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                learner.step_once(timeout=600)
-            jax.block_until_ready(
-                jax.tree.leaves(learner.params)[0]
-            )
-            dt = time.perf_counter() - t0
-            wait_frac = (learner._wait_accum - wait0) / dt
+            if drain_only:
+                # Pull assembled device batches off the bounded queue with
+                # no train step: host queue -> stacking -> device_put is
+                # the whole measured path.
+                arrays, _ = learner._batch_q.get(timeout=600)  # warmup
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    arrays, _ = learner._batch_q.get(timeout=600)
+                jax.block_until_ready(jax.tree.leaves(arrays)[0])
+                dt = time.perf_counter() - t0
+                wait_frac = None
+            else:
+                learner.step_once(timeout=600)  # compile + first batch
+                wait0 = learner._wait_accum
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    learner.step_once(timeout=600)
+                jax.block_until_ready(
+                    jax.tree.leaves(learner.params)[0]
+                )
+                dt = time.perf_counter() - t0
+                wait_frac = (learner._wait_accum - wait0) / dt
         finally:
             stop.set()
             learner.stop()
             for th in feeders:
                 th.join(timeout=10)
         frames = T * B * K * steps
-        return {
+        entry = {
             "frames_per_sec": round(frames / dt, 1),
             "ingest_MB_per_sec": round(
                 unroll_bytes * B * K * steps / dt / 1e6, 1
             ),
+            "steps": steps,
+            # Whether the ring-reuse stacking path engaged (auto-resolved
+            # by the aliasing probe; the big lever at large B).
+            "stack_reuse": bool(learner._stack_reuse),
+        }
+        if wait_frac is not None:
             # Fraction of learner wall-time spent waiting on the batcher:
             # ~0 => device-bound even at max feed; ~1 => host-feed-bound.
-            "batch_wait_frac": round(wait_frac, 4),
-            "steps": steps,
-        }
+            entry["batch_wait_frac"] = round(wait_frac, 4)
+        else:
+            entry["vs_62500_per_chip"] = round(frames / dt / 62_500.0, 3)
+        return entry
 
-    out = {"unroll_KB": round(unroll_bytes / 1e3, 1)}
-    configs_ = ((64, 1, 12), (256, 1, 8), (256, 4, 3)) if tpu_ok else (
-        (8, 1, 4),
-    )
-    for B, K, steps in configs_:
-        out[f"B{B}_K{K}"] = measure(B, K, steps)
-        log(f"bench: feeder B={B} K={K}: {out[f'B{B}_K{K}']}")
+    bytes_per_frame = unroll_bytes / T
+    out = {
+        "unroll_KB": round(unroll_bytes / 1e3, 1),
+        "bytes_per_frame": round(bytes_per_frame, 1),
+        # What the feed path MUST sustain: north-star pace per chip
+        # (62.5k frames/s = BASELINE.json:5 / 16) and the full 16-chip
+        # 1M frames/s figure, at this obs format's bytes/frame.
+        "required_GBps_per_chip_62500fps": round(
+            62_500 * bytes_per_frame / 1e9, 2
+        ),
+        "required_GBps_total_1Mfps_16chip": round(
+            1_000_000 * bytes_per_frame / 1e9, 2
+        ),
+    }
+    # Drain sweep (chip-independent): B x K grid, steps sized so each
+    # config moves >=60MB of unrolls — enough to amortize warmup on this
+    # 1-core box without starving the wall-clock alarm.
+    for B in (8, 64, 256):
+        for K in (1, 4):
+            steps = max(3, 4096 // (B * K))
+            key = f"drain_B{B}_K{K}"
+            out[key] = measure(B, K, steps, drain_only=True)
+            log(f"bench: feeder {key}: {out[key]}")
+    # Feed + train (TPU only: on CPU the train step dominates and the
+    # number is uninformative — r3's B8 config measured the CPU step, not
+    # the feed).
+    if tpu_ok:
+        for B, K, steps in ((64, 1, 12), (256, 1, 8), (256, 4, 3)):
+            key = f"train_B{B}_K{K}"
+            out[key] = measure(B, K, steps)
+            log(f"bench: feeder {key}: {out[key]}")
     return out
 
 
@@ -1097,16 +1152,20 @@ def run_attention_kernel_compare(jax) -> dict:
     return out
 
 
-def run_batcher_compare() -> dict:
-    """numpy vs native (C++) batch assembly at Atari shapes (VERDICT r1
-    weak #7: demonstrate where the native batcher wins). Host-side only —
-    measures stacking B unrolls of [T+1, 84, 84, 4] uint8 into the
-    time-major batch; >16MB payloads are where the native slot-parallel
-    copy threads should pay off."""
+def run_stack_reuse_compare() -> dict:
+    """Fresh-allocation vs ring-reuse batch stacking at Atari shapes
+    (VERDICT r3 item 5's resolution: the native C++ batcher lost to numpy
+    in every measurement for two rounds and was retired; the REAL feed-
+    path win is buffer reuse — fresh np.stack pays page faults +
+    first-touch zeroing on every large output, reuse doesn't). Host-side
+    only, chip-independent; LearnerConfig.stack_buffer_reuse is the
+    product flag."""
     import numpy as np
 
-    from torched_impala_tpu.native.stack import fast_stack_trajectories
-    from torched_impala_tpu.runtime.learner import stack_trajectories
+    from torched_impala_tpu.runtime.learner import (
+        alloc_stack_buffers,
+        stack_trajectories,
+    )
     from torched_impala_tpu.runtime.types import Trajectory
 
     out = {}
@@ -1130,27 +1189,37 @@ def run_batcher_compare() -> dict:
             for _ in range(B)
         ]
         mb = (T + 1) * B * 84 * 84 * 4 / 1e6
+        ring = [alloc_stack_buffers(trajs) for _ in range(2)]
+        # The fresh arm must model the REAL batcher's retention: queued +
+        # in-transfer batches stay alive, so malloc cannot just recycle
+        # the previous output (an immediately-freed fresh arm understates
+        # the allocation cost by ~3x at these sizes).
+        held = []
+
+        def fresh(i):
+            held.append(stack_trajectories(trajs))
+            if len(held) > 3:
+                held.pop(0)
 
         def timeit(fn, iters=30):
-            fn(trajs)  # warm
+            fn(0)  # warm
             t0 = time.perf_counter()
-            for _ in range(iters):
-                fn(trajs)
+            for i in range(iters):
+                fn(i)
             return (time.perf_counter() - t0) / iters * 1e3
 
-        numpy_ms = timeit(stack_trajectories)
-        native = fast_stack_trajectories(trajs)
+        fresh_ms = timeit(fresh)
+        reuse_ms = timeit(
+            lambda i: stack_trajectories(trajs, out=ring[i % 2])
+        )
         key = f"T{T}_B{B}_{mb:.0f}MB"
-        if native is None:
-            out[key] = {"numpy_ms": round(numpy_ms, 2), "native": "unavailable"}
-        else:
-            native_ms = timeit(fast_stack_trajectories)
-            out[key] = {
-                "numpy_ms": round(numpy_ms, 2),
-                "native_ms": round(native_ms, 2),
-                "native_speedup": round(numpy_ms / native_ms, 2),
-            }
-        log(f"bench: batcher {key}: {out[key]}")
+        out[key] = {
+            "fresh_ms": round(fresh_ms, 2),
+            "reuse_ms": round(reuse_ms, 2),
+            "reuse_speedup": round(fresh_ms / reuse_ms, 2),
+            "reuse_GBps": round(mb / reuse_ms, 2),
+        }
+        log(f"bench: stack reuse {key}: {out[key]}")
     return out
 
 

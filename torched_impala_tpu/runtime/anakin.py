@@ -195,11 +195,12 @@ class AnakinRunner:
         save is still reading (same hazard Learner.get_state documents)."""
         import numpy as np
 
+        from torched_impala_tpu.runtime.types import host_snapshot
         from torched_impala_tpu.utils.checkpoint import pack_rng
 
         return {
-            "params": jax.tree.map(np.asarray, self.params),
-            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "params": host_snapshot(self.params),
+            "opt_state": host_snapshot(self.opt_state),
             "num_frames": np.asarray(self.num_frames, np.int64),
             "num_steps": np.asarray(self.num_steps, np.int64),
             "rng": pack_rng(self._carry[0]),

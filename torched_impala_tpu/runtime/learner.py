@@ -52,6 +52,7 @@ from torched_impala_tpu.runtime.types import (
     QueueClosed,
     Trajectory,
     crossed_interval,
+    host_snapshot,
 )
 
 
@@ -95,14 +96,22 @@ class LearnerConfig:
     # forward per microbatch). batch_size must divide by G (and the
     # per-microbatch batch by the mesh's data axis).
     grad_accum: int = 1
-    # Assemble batches with the native (C++) batcher (native/batcher.cpp).
-    # Measured on this image (32x Atari unrolls): numpy np.stack already
-    # releases the GIL in its copy loops and is ~18% faster single-thread,
-    # so numpy is the default; the native path exists for hosts/batch
-    # shapes where its slot-parallel threading wins (>16MB batches) and as
-    # the runtime's native-component seam. Falls back to numpy if the .so
-    # can't build.
-    native_batcher: bool = False
+    # Stack batches into a ring of REUSED preallocated host buffers
+    # instead of fresh allocations. Measured on this image (Atari unrolls,
+    # pure-numpy isolation, 2026-07-31): fresh np.stack drops from
+    # 11.2 GB/s at 5 MB outputs to ~1.7 GB/s at 38-152 MB outputs (page
+    # faults + first-touch zeroing on every large allocation); stacking
+    # into a preallocated double buffer sustains 6-8 GB/s — a 3.6-4.9x
+    # feed-path win at exactly the B=256 headline shapes, and the
+    # difference between feeding the 62.5k frames/s/chip north star
+    # (needs ~1.85 GB/s at 29.7 KB/frame) or not. "auto" enables reuse
+    # unless a one-time probe detects that device_put ALIASES host numpy
+    # memory on this backend (a zero-copy backend would see later rounds'
+    # data; jax's CPU aliasing contract is version-dependent, so probe,
+    # don't assume). "on"/"off" force. The ring is a double buffer; each
+    # slot blocks out its previous transfer before reuse, so no in-flight
+    # H2D copy can be overwritten.
+    stack_buffer_reuse: str = "auto"
 
 
 def stack_trajectories(
@@ -155,6 +164,35 @@ def stack_trajectories(
         task=np.asarray([t.task for t in trajs], np.int32),
     )
     return batched
+
+
+def alloc_stack_buffers(
+    trajs: list[Trajectory], K: Optional[int] = None
+) -> Trajectory:
+    """Preallocate one stacking destination shaped for `stack_trajectories`
+    output (K=None) or a `[K, ...]` superbatch slice target (K given) —
+    the ring-reuse buffers LearnerConfig.stack_buffer_reuse stacks into."""
+    t0, B = trajs[0], len(trajs)
+    lead = () if K is None else (K,)
+
+    def stacked(x):
+        return np.empty(lead + (x.shape[0], B) + x.shape[1:], x.dtype)
+
+    def state(x):
+        return np.empty(lead + (B * x.shape[0],) + x.shape[1:], x.dtype)
+
+    return Trajectory(
+        obs=stacked(t0.obs),
+        first=stacked(t0.first),
+        actions=stacked(t0.actions),
+        behaviour_logits=stacked(t0.behaviour_logits),
+        rewards=stacked(t0.rewards),
+        cont=stacked(t0.cont),
+        agent_state=jax.tree.map(state, t0.agent_state),
+        actor_id=-1,
+        param_version=0,
+        task=np.empty(lead + (B,), np.int32),
+    )
 
 
 def stack_superbatch(batches: list[Trajectory]) -> Trajectory:
@@ -296,6 +334,30 @@ class Learner:
         self._batch_q: queue.Queue = queue.Queue(
             maxsize=config.device_queue_depth
         )
+        # Host stacking-buffer ring (LearnerConfig.stack_buffer_reuse).
+        # TWO slots suffice: a host buffer's job ends when its H2D copy
+        # completes (the device array owns the data from then on —
+        # non-aliasing backends only, which the "auto" probe guarantees),
+        # so the batcher stacks into slot B while slot A's transfer
+        # drains, and _ring_pending blocks out A's transfer before
+        # restacking it. A deeper ring would only pin more batches of
+        # device memory (the pending refs) for no extra overlap — a
+        # measured 6x throughput collapse at B=256,K=4 on a RAM-bound
+        # host. Buffers allocate lazily (shapes come from the first
+        # batch); `_stack_reuse` resolves lazily too (the aliasing probe
+        # does a device_put).
+        if config.stack_buffer_reuse not in ("auto", "on", "off"):
+            raise ValueError(
+                f"stack_buffer_reuse must be auto/on/off, got "
+                f"{config.stack_buffer_reuse!r}"
+            )
+        ring_size = 2
+        self._ring: list = [None] * ring_size
+        self._ring_pending: list = [None] * ring_size
+        self._ring_checked: list = [False] * ring_size
+        self._ring_idx = 0
+        self._last_slot: Optional[int] = None
+        self._stack_reuse: Optional[bool] = None
         self._stop = threading.Event()
         self._batcher_thread: Optional[threading.Thread] = None
         # A batcher-thread failure is recorded here and re-raised from the
@@ -638,26 +700,122 @@ class Learner:
                 continue
         return trajs
 
+    def _stack_reuse_enabled(self) -> bool:
+        """Resolve LearnerConfig.stack_buffer_reuse, probing once for the
+        aliasing hazard in "auto" mode: if device_put ALIASES host numpy
+        memory on this backend (zero-copy), reusing the buffer would let
+        later rounds' data bleed into batches still referenced on device,
+        so reuse must stay off."""
+        if self._stack_reuse is None:
+            mode = self._config.stack_buffer_reuse
+            if mode in ("on", "off"):
+                self._stack_reuse = mode == "on"
+            else:
+                # Capability probe: CAN device_put zero-copy (alias) host
+                # buffers on this backend? Measured on the jax CPU
+                # backend: 64-byte-aligned large buffers get aliased,
+                # others copied — an alignment lottery per allocation, so
+                # a single trial is meaningless and ANY aliasing
+                # capability disqualifies reuse (an aliased ring buffer
+                # would corrupt queued batches on restack). TPU backends
+                # always copy H2D, so the probe enables reuse exactly
+                # where the feed-path win matters. np.shares_memory is
+                # timing-independent (a mutate-and-read probe raced
+                # jax's async materialization and flaked).
+                aliased = False
+                for _ in range(8):
+                    probe = np.zeros((1 << 20,), np.uint8)
+                    if self._mesh is None:
+                        d = jax.device_put(probe)
+                    else:
+                        d = jax.device_put(
+                            probe, next(iter(self._mesh.devices.flat))
+                        )
+                    jax.block_until_ready(d)
+                    aliased |= bool(
+                        np.shares_memory(np.asarray(d), probe)
+                    )
+                    if aliased:
+                        break
+                self._stack_reuse = not aliased
+        return self._stack_reuse
+
+    def _stack_out(
+        self, trajs: list[Trajectory], K: Optional[int] = None
+    ) -> Optional[Trajectory]:
+        """Next ring stacking buffer (None when reuse is off). Blocks out
+        the slot's previous device transfer before handing it back."""
+        if not self._stack_reuse_enabled():
+            return None
+        i = self._ring_idx % len(self._ring)
+        self._ring_idx += 1
+        pending = self._ring_pending[i]
+        if pending is not None:
+            # The device arrays built from this slot's previous round:
+            # until block_until_ready returns, jax's (possibly background-
+            # dispatched) copy may still read the host buffer, so the
+            # block must NEVER be skipped — strong references, not
+            # weakrefs (a dead weakref can't prove the copy ran; an early
+            # version skipped the block on dead refs and raced).
+            jax.block_until_ready(pending)
+            self._ring_pending[i] = None
+        if self._ring[i] is None:
+            self._ring[i] = alloc_stack_buffers(trajs, K)
+        self._last_slot = i
+        return self._ring[i]
+
+    def _record_pending_transfer(self, on_device) -> None:
+        """Remember the device arrays built from the last ring slot so the
+        slot blocks them out before reuse. Strong references by design:
+        a dead weakref cannot prove the (possibly background-dispatched)
+        copy ran, so the block must never be skippable. The refs pin at
+        most the two ring slots' batches in device memory — usually still
+        alive in the device queue anyway — and are dropped as the ring
+        wraps."""
+        if not self._stack_reuse_enabled() or self._last_slot is None:
+            return
+        slot, self._last_slot = self._last_slot, None
+        leaves = jax.tree.leaves(on_device)
+        if not self._ring_checked[slot]:
+            # One-time per-slot safety net (covers a force-"on" config on
+            # an aliasing backend the auto probe would have rejected): if
+            # any device array actually aliases this slot's host buffers,
+            # restacking would corrupt live batches — surrender the ring
+            # and fall back to fresh allocation permanently. Costs one
+            # D2H read per slot, not per batch; skipped when the arrays
+            # aren't host-addressable (multihost shards).
+            self._ring_checked[slot] = True
+            bufs = [
+                leaf
+                for leaf in jax.tree.leaves(self._ring[slot])
+                if isinstance(leaf, np.ndarray)
+            ]
+            try:
+                aliased = any(
+                    np.shares_memory(np.asarray(d), b)
+                    for d in leaves
+                    for b in bufs
+                )
+            except Exception:
+                aliased = False
+            if aliased:
+                self._stack_reuse = False
+                self._ring = [None] * len(self._ring)
+                self._ring_pending = [None] * len(self._ring_pending)
+                return
+        self._ring_pending[slot] = leaves
+
     def _assemble_batch(self) -> Optional[Trajectory]:
         trajs = self._collect_trajs()
         if trajs is None:
             return None
-        if self._config.native_batcher:
-            from torched_impala_tpu.native.stack import (
-                fast_stack_trajectories,
-            )
-
-            batch = fast_stack_trajectories(trajs)
-            if batch is not None:
-                return batch
-        return stack_trajectories(trajs)
+        return stack_trajectories(trajs, out=self._stack_out(trajs))
 
     def _assemble_superbatch(self, K: int) -> Optional[Trajectory]:
         """`[K, ...]` superbatch, each slice stacked in place so every
-        unroll is copied once (not batch-then-restack). Allocation shapes
-        come from the first round's trajectories. Bypasses the native
-        batcher (which can't target views); numpy measured faster on this
-        host anyway (LearnerConfig.native_batcher)."""
+        unroll is copied once (not batch-then-restack). The destination is
+        a ring buffer when reuse is on, else a fresh allocation shaped
+        from the first round's trajectories."""
         sb: Optional[Trajectory] = None
         versions = []
         for k in range(K):
@@ -665,32 +823,9 @@ class Learner:
             if trajs is None:
                 return None
             if sb is None:
-                t0, B = trajs[0], len(trajs)
-
-                def _alloc_stacked(x):
-                    # [T(+1), ...] per unroll -> [K, T(+1), B, ...]
-                    return np.empty(
-                        (K, x.shape[0], B) + x.shape[1:], x.dtype
-                    )
-
-                def _alloc_state(x):
-                    # [b, ...] per unroll, concatenated over axis 0.
-                    return np.empty(
-                        (K, B * x.shape[0]) + x.shape[1:], x.dtype
-                    )
-
-                sb = Trajectory(
-                    obs=_alloc_stacked(t0.obs),
-                    first=_alloc_stacked(t0.first),
-                    actions=_alloc_stacked(t0.actions),
-                    behaviour_logits=_alloc_stacked(t0.behaviour_logits),
-                    rewards=_alloc_stacked(t0.rewards),
-                    cont=_alloc_stacked(t0.cont),
-                    agent_state=jax.tree.map(_alloc_state, t0.agent_state),
-                    actor_id=-1,
-                    param_version=0,
-                    task=np.empty((K, B), np.int32),
-                )
+                sb = self._stack_out(trajs, K)
+                if sb is None:  # reuse off: fresh allocation
+                    sb = alloc_stack_buffers(trajs, K)
             view = Trajectory(
                 obs=sb.obs[k],
                 first=sb.first[k],
@@ -747,6 +882,7 @@ class Learner:
                 on_device = multihost.place_batch(
                     self._batch_shardings, arrays
                 )
+            self._record_pending_transfer(on_device)
             while True:
                 if self._stop.is_set():
                     return
@@ -777,8 +913,13 @@ class Learner:
         for leaf in jax.tree.leaves(self._params):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
-        host_params = jax.tree.map(np.asarray, self._params)
-        self.param_store.publish(self.num_frames, host_params)
+
+        # host_snapshot, not bare np.asarray: the train step DONATES the
+        # param buffers, so a zero-copy view here would let actors' params
+        # silently morph when XLA reuses the memory (see types.host_snapshot).
+        self.param_store.publish(
+            self.num_frames, host_snapshot(self._params)
+        )
 
     def step_once(self, timeout: Optional[float] = None) -> Mapping[str, Any]:
         """Block for one device batch, take one SGD step, publish params.
@@ -902,8 +1043,8 @@ class Learner:
         from torched_impala_tpu.utils.checkpoint import pack_rng
 
         state = {
-            "params": jax.tree.map(np.asarray, self._params),
-            "opt_state": jax.tree.map(np.asarray, self._opt_state),
+            "params": host_snapshot(self._params),
+            "opt_state": host_snapshot(self._opt_state),
             "num_frames": np.asarray(self.num_frames, np.int64),
             "num_steps": np.asarray(self.num_steps, np.int64),
             "rng": np.asarray(pack_rng(self._rng)),
@@ -912,9 +1053,7 @@ class Learner:
         # identical to pre-PopArt ones (orbax restore requires matching
         # structures, so an always-present key would break old checkpoints).
         if self._config.popart is not None:
-            state["popart_state"] = jax.tree.map(
-                np.asarray, self._popart_state
-            )
+            state["popart_state"] = host_snapshot(self._popart_state)
         return state
 
     def set_state(self, state: Mapping[str, Any]) -> None:

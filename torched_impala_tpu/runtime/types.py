@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple
 
+import jax
 import numpy as np
 
 
@@ -50,6 +51,26 @@ class Trajectory(NamedTuple):
     actor_id: int = 0
     param_version: int = 0
     task: int = 0
+
+
+def host_snapshot(tree: Any) -> Any:
+    """Materialize a pytree of (possibly device) arrays as host numpy that
+    OWNS its memory.
+
+    `np.asarray` of a jax CPU array can be a zero-copy VIEW of the device
+    buffer; if the source array is later dropped (or its buffer donated),
+    the view can silently morph into whatever the allocator reuses the
+    memory for — observed live: a drained batch's "copy" turning into
+    batch i+4's data. Every long-lived host capture (published actor
+    params, checkpoint snapshots, trajectory start states) must own its
+    bytes. On TPU `np.asarray` is already a fresh D2H copy, and the
+    owndata check keeps that single-copy."""
+
+    def owned(leaf):
+        arr = np.asarray(leaf)
+        return arr if arr.flags.owndata else np.array(arr, copy=True)
+
+    return jax.tree.map(owned, tree)
 
 
 def crossed_interval(num_steps: int, delta: int, interval: int) -> bool:

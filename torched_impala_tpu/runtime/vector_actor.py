@@ -27,7 +27,11 @@ import numpy as np
 
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.runtime.param_store import ParamStore
-from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
+from torched_impala_tpu.runtime.types import (
+    QueueClosed,
+    Trajectory,
+    host_snapshot,
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -142,7 +146,11 @@ class VectorActor:
         rewards = np.empty((T, E), np.float32)
         cont = np.empty((T, E), np.float32)
         logits_buf = None
-        start_state = jax.tree.map(np.asarray, self._state)
+        # host_snapshot, not bare np.asarray: the snapshot outlives
+        # self._state (it rides the Trajectory through the learner queue),
+        # and an np.asarray VIEW of a dropped jax CPU array can morph when
+        # the allocator reuses the buffer (types.host_snapshot).
+        start_state = host_snapshot(self._state)
 
         for t in range(T):
             obs_buf[t] = self._obs
