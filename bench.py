@@ -225,7 +225,11 @@ def main(args) -> None:
         # and the anakin_pixels locked configs (no sweep).
         section(
             "learner_fused",
-            lambda: run_bench_fused(jax, ks=(8,)),
+            lambda: run_bench_fused(
+                jax,
+                ks=(8,),
+                single_step_flops=result.get("train_step_gflops", 0.0) * 1e9,
+            ),
             gate=tpu_ok,
         )
         _promote_fused(result)
@@ -244,7 +248,14 @@ def main(args) -> None:
 
     # Cheap, high-value TPU sections first so a slow e2e (host-bound on a
     # low-core box) hitting the wall-clock alarm can't starve them.
-    section("learner_fused", lambda: run_bench_fused(jax), gate=tpu_ok)
+    section(
+        "learner_fused",
+        lambda: run_bench_fused(
+            jax,
+            single_step_flops=result.get("train_step_gflops", 0.0) * 1e9,
+        ),
+        gate=tpu_ok,
+    )
     _promote_fused(result)
     section("learner_deep_breakout", lambda: run_bench_deep(jax), gate=tpu_ok)
     section("learner_scaling", lambda: run_bench_scaling(jax), gate=tpu_ok)
@@ -605,7 +616,7 @@ def run_bench_remat(jax) -> dict:
     return out
 
 
-def run_bench_fused(jax, ks=(4, 8)) -> dict:
+def run_bench_fused(jax, ks=(4, 8), single_step_flops: float = 0.0) -> dict:
     """Fused-dispatch learner throughput (LearnerConfig.steps_per_dispatch):
     K SGD steps per dispatched XLA program at the headline Pong shapes.
     Amortizes the fixed per-dispatch host latency (~24% of step wall time
@@ -635,12 +646,19 @@ def run_bench_fused(jax, ks=(4, 8)) -> dict:
         dispatches = max(1, 30 // K)
         fps, dt = fx.timed_frames_per_sec(dispatches)
         out[f"K{K}"] = round(fps / n_chips, 1)
-        # cost_analysis of the fused executable already counts all K steps.
+        # XLA's cost_analysis counts a scan/while BODY once, not x trip
+        # count (measured live r4: the fused-K=8 executable reports ~1x the
+        # single-step flops, which made the old per-dispatch formula report
+        # MFU/K). The headline section's cost_analysis of the IDENTICAL
+        # model/shapes at K=1 is the reliable per-step count, so prefer it.
         flops = fx.flops_per_step()
-        if flops > 0:
+        per_step = single_step_flops if single_step_flops > 0 else flops
+        if per_step > 0:
             out[f"K{K}_mfu_estimate"] = round(
-                (flops * dispatches / dt) / 197e12, 4
+                (per_step * K * dispatches / dt) / 197e12, 4
             )
+        if flops > 0:
+            out[f"K{K}_costanalysis_gflops"] = round(flops / 1e9, 1)
         log(f"bench: fused K={K}: {out[f'K{K}']:,.0f} frames/s/chip")
     return out
 
@@ -1077,7 +1095,15 @@ def run_attention_kernel_compare(jax) -> dict:
 
     out = {}
     rng = np.random.default_rng(0)
-    for B, T, H, dh, W in ((32, 21, 4, 64, 128), (8, 101, 4, 64, 128)):
+    # Preset shapes (W=128 cache) + a long-context dense causal shape
+    # (T=S=1024) where the einsum path materializes the [B, H, T, S]
+    # logits/probs in HBM and the flash kernel's O(tile) residency should
+    # pay off.
+    for B, T, H, dh, W in (
+        (32, 21, 4, 64, 128),
+        (8, 101, 4, 64, 128),
+        (8, 1024, 4, 64, 0),
+    ):
         S = W + T
         q = jnp.asarray(rng.normal(size=(B, T, H, dh)), jnp.float32)
         k = jnp.asarray(rng.normal(size=(B, S, H, dh)), jnp.float32)
@@ -1112,11 +1138,17 @@ def run_attention_kernel_compare(jax) -> dict:
             )
         )
         einsum_fwd = jax.jit(einsum_ref)
+        # Loose compiled-equivalence guard only: BOTH paths run at the
+        # backend's default matmul precision (bf16 passes on the MXU), so
+        # they differ from each other by bf16 rounding (~1e-2 on O(1)
+        # outputs). Strict parity at `highest` precision is pinned in
+        # tests/test_attention_pallas.py; this assert just catches a
+        # wrong-mask/wrong-shape regression before timing garbage.
         np.testing.assert_allclose(
             np.asarray(pallas_fwd(q, k, v)),
             np.asarray(einsum_fwd(q, k, v)),
-            rtol=2e-4,
-            atol=2e-4,
+            rtol=2e-2,
+            atol=2e-2,
         )
         pallas_bwd = jax.jit(
             jax.grad(lambda q, k, v: jnp.sum(jnp.sin(

@@ -51,6 +51,7 @@ def test_two_process_global_mesh_learner_step():
         outs.append(out)
 
     losses, loop_losses, seed_sets, fused_losses = [], [], [], []
+    tp_losses, tp_sharded = [], []
     for out in outs:
         lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
         assert len(lines) == 1, out
@@ -68,6 +69,14 @@ def test_two_process_global_mesh_learner_step():
         ]
         assert len(lines3) == 1, out
         fused_losses.append(float(lines3[0].split("loss=")[1]))
+        lines4 = [
+            ln for ln in out.splitlines() if ln.startswith("RESULT4 ")
+        ]
+        assert len(lines4) == 1, out
+        tp_losses.append(
+            float(lines4[0].split("loss=")[1].split(" ")[0])
+        )
+        tp_sharded.append(int(lines4[0].split("sharded=")[1]))
     # One global batch, one SPMD program: both controllers see THE loss.
     assert np.isfinite(losses[0])
     assert losses[0] == losses[1]
@@ -81,3 +90,11 @@ def test_two_process_global_mesh_learner_step():
     # assembles across hosts and both controllers report THE same loss.
     assert np.isfinite(fused_losses[0])
     assert fused_losses[0] == fused_losses[1]
+    # DP x TP (4x2 global mesh under jax.distributed): weights genuinely
+    # model-sharded, same loss on both controllers, and — same batch, same
+    # init — the loss matches the DP-only phase up to reduction-order
+    # noise (layout choice cannot change the math).
+    assert tp_sharded[0] > 0 and tp_sharded[0] == tp_sharded[1]
+    assert np.isfinite(tp_losses[0])
+    assert tp_losses[0] == tp_losses[1]
+    np.testing.assert_allclose(tp_losses[0], losses[0], rtol=1e-5)

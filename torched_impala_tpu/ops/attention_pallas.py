@@ -67,13 +67,12 @@ def _visible_tile(
 ):
     """The visibility mask every kernel shares (THE correctness-critical
     invariant: cache slot or causal in-unroll, same episode). seg_q
-    `[Tb]`, seg_c `[Sb]`; offsets are the tile's absolute start rows/cols
-    in the padded [Tp, Sp] score matrix."""
+    `[Tb, 1]` (sublane-oriented), seg_c `[1, Sb]` (lane-oriented) so the
+    equality broadcast is a native 2D op on the VPU; offsets are the
+    tile's absolute start rows/cols in the padded [Tp, Sp] score matrix."""
     tq = t_offset + jax.lax.broadcasted_iota(jnp.int32, (Tb, Sb), 0)
     s_idx = s_offset + jax.lax.broadcasted_iota(jnp.int32, (Tb, Sb), 1)
-    return (seg_q[:, None] == seg_c[None, :]) & (
-        (s_idx < W) | (s_idx - W <= tq)
-    )
+    return (seg_q == seg_c) & ((s_idx < W) | (s_idx - W <= tq))
 
 
 def _tile_may_see(t_offset, s_offset, Tb: int, W: int):
@@ -113,23 +112,23 @@ def _dot(a, b, dims):
 
 def _tile_probs(q, k, seg_q, seg_c, lse, t_off, s_off, scale, W):
     """Recompute one [Tb, Sb] probability tile from q/k + the forward's
-    row logsumexp (backward-pass rematerialization). Masked entries are
-    zeroed EXPLICITLY (never via exp alone): padded rows carry lse=NEG_INF
-    and would otherwise produce inf."""
+    row logsumexp (backward-pass rematerialization). `lse` is `[Tb, 1]`.
+    Masked entries are zeroed EXPLICITLY (never via exp alone): padded
+    rows carry lse=NEG_INF and would otherwise produce inf."""
     Tb, Sb = q.shape[0], k.shape[0]
     logits = _dot(q, k, ((1,), (1,))) * scale
     visible = _visible_tile(seg_q, seg_c, t_off, s_off, Tb, Sb, W)
-    return jnp.where(visible, jnp.exp(logits - lse[:, None]), 0.0)
+    return jnp.where(visible, jnp.exp(logits - lse), 0.0)
 
 
 def _fwd_kernel(
-    q_ref,  # [1, Tb, 1, dh]
-    k_ref,  # [1, Sb, 1, dh]
-    v_ref,  # [1, Sb, 1, dh]
-    segq_ref,  # [1, Tb] int32
-    segc_ref,  # [1, Sb] int32
-    o_ref,  # [1, Tb, 1, dh]
-    lse_ref,  # [1, 1, Tb]
+    q_ref,  # [1, 1, Tb, dh]
+    k_ref,  # [1, 1, Sb, dh]
+    v_ref,  # [1, 1, Sb, dh]
+    segq_ref,  # [1, Tb, 1] int32 (sublane-oriented)
+    segc_ref,  # [1, 1, Sb] int32 (lane-oriented)
+    o_ref,  # [1, 1, Tb, dh]
+    lse_ref,  # [1, 1, Tb, 1]
     m_scr,  # [Tb, 1] scratch: running row max
     l_scr,  # [Tb, 1] scratch: running normalizer
     acc_scr,  # [Tb, dh] scratch: running output accumulator
@@ -142,8 +141,8 @@ def _fwd_kernel(
     (innermost grid dim) carrying (m, l, acc) in VMEM scratch; emit the
     normalized output and the row logsumexp after the last tile."""
     s = pl.program_id(3)
-    Tb = q_ref.shape[1]
-    Sb = k_ref.shape[1]
+    Tb = q_ref.shape[2]
+    Sb = k_ref.shape[2]
 
     @pl.when(s == 0)
     def _init():
@@ -155,12 +154,12 @@ def _fwd_kernel(
 
     @pl.when(_tile_may_see(t_off, s * Sb, Tb, W))
     def _online_update():
-        q = q_ref[0, :, 0, :]  # [Tb, dh]
-        k = k_ref[0, :, 0, :]  # [Sb, dh]
-        v = v_ref[0, :, 0, :]
+        q = q_ref[0, 0]  # [Tb, dh]
+        k = k_ref[0, 0]  # [Sb, dh]
+        v = v_ref[0, 0]
         logits = _dot(q, k, ((1,), (1,))) * scale  # [Tb, Sb]
         visible = _visible_tile(
-            segq_ref[0, :], segc_ref[0, :], t_off, s * Sb, Tb, Sb, W
+            segq_ref[0], segc_ref[0], t_off, s * Sb, Tb, Sb, W
         )
         logits = jnp.where(visible, logits, NEG_INF)
 
@@ -187,8 +186,8 @@ def _fwd_kernel(
         # sentinel-padded query rows, which the caller slices off. Keep
         # them finite anyway so no NaN/inf ever leaves the kernel.
         safe_l = jnp.where(l > 0, l, 1.0)
-        o_ref[0, :, 0, :] = acc_scr[...] / safe_l
-        lse_ref[0, 0, :] = (m_scr[...] + jnp.log(safe_l))[:, 0]
+        o_ref[0, 0] = acc_scr[...] / safe_l
+        lse_ref[0, 0] = m_scr[...] + jnp.log(safe_l)
 
 
 def _block_sizes(T: int, S: int):
@@ -199,11 +198,25 @@ def _block_sizes(T: int, S: int):
 
 def _tile_specs(Tb: int, Sb: int, dh: int, t_inner: bool):
     """The five BlockSpecs every kernel grid uses, for a (b, h, x, y)
-    grid: t_inner=False means (x, y) = (t-block, s-block) — the forward
-    and dQ sweeps; t_inner=True means (x, y) = (s-block, t-block) — the
-    dK/dV sweep, where the s block stays resident while t streams.
-    Returns (t_spec, s_spec, row_spec, segq_spec, segc_spec); row_spec
-    covers the [B, H, Tp]-shaped per-query-row tensors (lse, D)."""
+    grid over `[B, H, seq, dh]`-layout tensors: t_inner=False means
+    (x, y) = (t-block, s-block) — the forward and dQ sweeps;
+    t_inner=True means (x, y) = (s-block, t-block) — the dK/dV sweep,
+    where the s block stays resident while t streams.
+
+    Layouts are chosen so every block's LAST TWO dims satisfy the TPU
+    tiling rule (divisible by (8, 128) or equal to the array dims) —
+    the r4 on-chip lowering failure of the first flash rebuild, which
+    blocked H at 1 in a `[B, T, H, dh]` layout and only ever ran in
+    interpret mode under the CPU conftest:
+
+    - q/k/v/g/o: `[B, H, seq, dh]`, block (1, 1, Tb|Sb, dh) — seq is a
+      multiple of 8, dh equals the array dim;
+    - lse/D rows: `[B, H, Tp, 1]`, block (1, 1, Tb, 1) — sublane rows
+      broadcast directly against [Tb, Sb] tiles;
+    - seg_q: `[B, Tp, 1]` (sublane), seg_c: `[B, 1, Sp]` (lane) so the
+      in-kernel equality is a native [Tb,1]==[1,Sb] broadcast.
+
+    Returns (t_spec, s_spec, row_spec, segq_spec, segc_spec)."""
 
     def pick(x, y):
         return (y, x) if t_inner else (x, y)
@@ -212,30 +225,40 @@ def _tile_specs(Tb: int, Sb: int, dh: int, t_inner: bool):
         return pl.BlockSpec(block, index_map, memory_space=pltpu.VMEM)
 
     return (
-        vmem((1, Tb, 1, dh), lambda b, h, x, y: (b, pick(x, y)[0], h, 0)),
-        vmem((1, Sb, 1, dh), lambda b, h, x, y: (b, pick(x, y)[1], h, 0)),
-        vmem((1, 1, Tb), lambda b, h, x, y: (b, h, pick(x, y)[0])),
-        vmem((1, Tb), lambda b, h, x, y: (b, pick(x, y)[0])),
-        vmem((1, Sb), lambda b, h, x, y: (b, pick(x, y)[1])),
+        vmem((1, 1, Tb, dh), lambda b, h, x, y: (b, h, pick(x, y)[0], 0)),
+        vmem((1, 1, Sb, dh), lambda b, h, x, y: (b, h, pick(x, y)[1], 0)),
+        vmem((1, 1, Tb, 1), lambda b, h, x, y: (b, h, pick(x, y)[0], 0)),
+        vmem((1, Tb, 1), lambda b, h, x, y: (b, pick(x, y)[0], 0)),
+        vmem((1, 1, Sb), lambda b, h, x, y: (b, 0, pick(x, y)[1])),
     )
 
 
 def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
-    """Returns (out `[B, T, H, dh]` f32, lse `[B, H, Tp]` f32)."""
+    """Returns (out `[B, T, H, dh]` f32, lse `[B, H, Tp, 1]` f32)."""
     B, T, H, dh = q.shape
     S = k_ctx.shape[1]
     f32 = jnp.float32
-    q, k_ctx, v_ctx = (jnp.asarray(x, f32) for x in (q, k_ctx, v_ctx))
 
-    # Pad T and S to the tile grid. Padded context slots carry a sentinel
-    # segment (visible to nothing => explicitly zeroed probability);
-    # padded query rows see no visible context and emit zeros + a finite
-    # sentinel lse, then are sliced off.
+    # Kernel layout is [B, H, seq, dh] (see _tile_specs); pad T and S to
+    # the tile grid. Padded context slots carry a sentinel segment
+    # (visible to nothing => explicitly zeroed probability); padded query
+    # rows see no visible context and emit zeros + a finite sentinel lse,
+    # then are sliced off.
     Tb, Tp, Sb, Sp = _block_sizes(T, S)
-    qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
-    kp = jnp.pad(k_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
-    vp = jnp.pad(v_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    qp = jnp.pad(
+        jnp.asarray(q, f32).transpose(0, 2, 1, 3),
+        ((0, 0), (0, 0), (0, Tp - T), (0, 0)),
+    )
+    kp = jnp.pad(
+        jnp.asarray(k_ctx, f32).transpose(0, 2, 1, 3),
+        ((0, 0), (0, 0), (0, Sp - S), (0, 0)),
+    )
+    vp = jnp.pad(
+        jnp.asarray(v_ctx, f32).transpose(0, 2, 1, 3),
+        ((0, 0), (0, 0), (0, Sp - S), (0, 0)),
+    )
     segq_p, segc_p = _pad_segs(seg_q, seg_ctx, Tp, Sp)
+    segq_p, segc_p = segq_p[:, :, None], segc_p[:, None, :]
 
     kernel = functools.partial(
         _fwd_kernel, scale=1.0 / (dh**0.5), W=W, num_s=Sp // Sb
@@ -249,8 +272,8 @@ def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
         in_specs=[q_spec, kv_spec, kv_spec, segq_spec, segc_spec],
         out_specs=(q_spec, lse_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((B, Tp, H, dh), f32),
-            jax.ShapeDtypeStruct((B, H, Tp), f32),
+            jax.ShapeDtypeStruct((B, H, Tp, dh), f32),
+            jax.ShapeDtypeStruct((B, H, Tp, 1), f32),
         ),
         scratch_shapes=[
             pltpu.VMEM((Tb, 1), f32),
@@ -259,19 +282,19 @@ def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
         ],
         interpret=interpret,
     )(qp, kp, vp, segq_p, segc_p)
-    return out[:, :T], lse
+    return out.transpose(0, 2, 1, 3)[:, :T], lse
 
 
 def _dq_kernel(
-    q_ref,  # [1, Tb, 1, dh]
-    k_ref,  # [1, Sb, 1, dh]
-    v_ref,  # [1, Sb, 1, dh]
-    g_ref,  # [1, Tb, 1, dh] output cotangent
-    lse_ref,  # [1, 1, Tb]
-    dcap_ref,  # [1, 1, Tb]  D_i = sum_d O_id dO_id
-    segq_ref,  # [1, Tb]
-    segc_ref,  # [1, Sb]
-    dq_ref,  # [1, Tb, 1, dh]
+    q_ref,  # [1, 1, Tb, dh]
+    k_ref,  # [1, 1, Sb, dh]
+    v_ref,  # [1, 1, Sb, dh]
+    g_ref,  # [1, 1, Tb, dh] output cotangent
+    lse_ref,  # [1, 1, Tb, 1]
+    dcap_ref,  # [1, 1, Tb, 1]  D_i = sum_d O_id dO_id
+    segq_ref,  # [1, Tb, 1]
+    segc_ref,  # [1, 1, Sb]
+    dq_ref,  # [1, 1, Tb, dh]
     dq_scr,  # [Tb, dh] scratch
     *,
     scale: float,
@@ -282,8 +305,8 @@ def _dq_kernel(
     dS = P * (dP - D), dQ = dS K * scale, with P recomputed per tile
     from the saved logsumexp."""
     s = pl.program_id(3)
-    Tb = q_ref.shape[1]
-    Sb = k_ref.shape[1]
+    Tb = q_ref.shape[2]
+    Sb = k_ref.shape[2]
 
     @pl.when(s == 0)
     def _init():
@@ -293,34 +316,34 @@ def _dq_kernel(
 
     @pl.when(_tile_may_see(t_off, s * Sb, Tb, W))
     def _accumulate():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
-        g = g_ref[0, :, 0, :]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]
         p = _tile_probs(
-            q, k, segq_ref[0, :], segc_ref[0, :], lse_ref[0, 0, :],
+            q, k, segq_ref[0], segc_ref[0], lse_ref[0, 0],
             t_off, s * Sb, scale, W,
         )  # [Tb, Sb]
         dp = _dot(g, v, ((1,), (1,)))  # [Tb, Sb]
-        ds = p * (dp - dcap_ref[0, 0, :][:, None])
+        ds = p * (dp - dcap_ref[0, 0])
         dq_scr[...] += _dot(ds, k, ((1,), (0,))) * scale
 
     @pl.when(s == num_s - 1)
     def _emit():
-        dq_ref[0, :, 0, :] = dq_scr[...]
+        dq_ref[0, 0] = dq_scr[...]
 
 
 def _dkv_kernel(
-    q_ref,  # [1, Tb, 1, dh]
-    k_ref,  # [1, Sb, 1, dh]
-    v_ref,  # [1, Sb, 1, dh]
-    g_ref,  # [1, Tb, 1, dh]
-    lse_ref,  # [1, 1, Tb]
-    dcap_ref,  # [1, 1, Tb]
-    segq_ref,  # [1, Tb]
-    segc_ref,  # [1, Sb]
-    dk_ref,  # [1, Sb, 1, dh]
-    dv_ref,  # [1, Sb, 1, dh]
+    q_ref,  # [1, 1, Tb, dh]
+    k_ref,  # [1, 1, Sb, dh]
+    v_ref,  # [1, 1, Sb, dh]
+    g_ref,  # [1, 1, Tb, dh]
+    lse_ref,  # [1, 1, Tb, 1]
+    dcap_ref,  # [1, 1, Tb, 1]
+    segq_ref,  # [1, Tb, 1]
+    segc_ref,  # [1, 1, Sb]
+    dk_ref,  # [1, 1, Sb, dh]
+    dv_ref,  # [1, 1, Sb, dh]
     dk_scr,  # [Sb, dh] scratch
     dv_scr,  # [Sb, dh] scratch
     *,
@@ -331,8 +354,8 @@ def _dkv_kernel(
     """dK/dV for one (b, h, s-block), accumulated over the T sweep
     (innermost grid dim): dV = P^T dO, dK = dS^T Q * scale."""
     t = pl.program_id(3)
-    Tb = q_ref.shape[1]
-    Sb = k_ref.shape[1]
+    Tb = q_ref.shape[2]
+    Sb = k_ref.shape[2]
 
     @pl.when(t == 0)
     def _init():
@@ -343,23 +366,23 @@ def _dkv_kernel(
 
     @pl.when(_tile_may_see(t * Tb, s_off, Tb, W))
     def _accumulate():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
-        g = g_ref[0, :, 0, :]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]
         p = _tile_probs(
-            q, k, segq_ref[0, :], segc_ref[0, :], lse_ref[0, 0, :],
+            q, k, segq_ref[0], segc_ref[0], lse_ref[0, 0],
             t * Tb, s_off, scale, W,
         )  # [Tb, Sb]
         dv_scr[...] += _dot(p, g, ((0,), (0,)))  # [Sb, dh]
         dp = _dot(g, v, ((1,), (1,)))  # [Tb, Sb]
-        ds = p * (dp - dcap_ref[0, 0, :][:, None])
+        ds = p * (dp - dcap_ref[0, 0])
         dk_scr[...] += _dot(ds, q, ((0,), (0,))) * scale
 
     @pl.when(t == num_t - 1)
     def _emit():
-        dk_ref[0, :, 0, :] = dk_scr[...]
-        dv_ref[0, :, 0, :] = dv_scr[...]
+        dk_ref[0, 0] = dk_scr[...]
+        dv_ref[0, 0] = dv_scr[...]
 
 
 def _bwd_pallas(q, k_ctx, v_ctx, g, o, lse, seg_q, seg_ctx, W, interpret):
@@ -368,20 +391,23 @@ def _bwd_pallas(q, k_ctx, v_ctx, g, o, lse, seg_q, seg_ctx, W, interpret):
     B, T, H, dh = q.shape
     S = k_ctx.shape[1]
     f32 = jnp.float32
+    # Kernel layout is [B, H, seq, dh] (see _tile_specs).
     q, k_ctx, v_ctx, g, o = (
-        jnp.asarray(x, f32) for x in (q, k_ctx, v_ctx, g, o)
+        jnp.asarray(x, f32).transpose(0, 2, 1, 3)
+        for x in (q, k_ctx, v_ctx, g, o)
     )
     Tb, Tp, Sb, Sp = _block_sizes(T, S)
-    qp = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
-    gp = jnp.pad(g, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
-    kp = jnp.pad(k_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
-    vp = jnp.pad(v_ctx, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    pad_t = ((0, 0), (0, 0), (0, Tp - T), (0, 0))
+    pad_s = ((0, 0), (0, 0), (0, Sp - S), (0, 0))
+    qp, gp = jnp.pad(q, pad_t), jnp.pad(g, pad_t)
+    kp, vp = jnp.pad(k_ctx, pad_s), jnp.pad(v_ctx, pad_s)
     segq_p, segc_p = _pad_segs(seg_q, seg_ctx, Tp, Sp)
-    # D_i = sum_d O_id dO_id, the softmax-Jacobian row term; [B, H, Tp]
+    segq_p, segc_p = segq_p[:, :, None], segc_p[:, None, :]
+    # D_i = sum_d O_id dO_id, the softmax-Jacobian row term; [B, H, Tp, 1]
     # to match lse's layout. Padded rows: zero-padded => D = 0 there.
     dcap = jnp.pad(
-        jnp.einsum("bthd,bthd->bht", o, g), ((0, 0), (0, 0), (0, Tp - T))
-    )
+        jnp.einsum("bhtd,bhtd->bht", o, g), ((0, 0), (0, 0), (0, Tp - T))
+    )[..., None]
 
     scale = 1.0 / (dh**0.5)
     t_spec, s_spec, row_spec, segq_spec, segc_spec = _tile_specs(
@@ -397,7 +423,7 @@ def _bwd_pallas(q, k_ctx, v_ctx, g, o, lse, seg_q, seg_ctx, W, interpret):
             segq_spec, segc_spec,
         ],
         out_specs=t_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Tp, H, dh), f32),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, dh), f32),
         scratch_shapes=[pltpu.VMEM((Tb, dh), f32)],
         interpret=interpret,
     )(qp, kp, vp, gp, lse, dcap, segq_p, segc_p)
@@ -418,8 +444,8 @@ def _bwd_pallas(q, k_ctx, v_ctx, g, o, lse, seg_q, seg_ctx, W, interpret):
         ],
         out_specs=(s_spec2, s_spec2),
         out_shape=(
-            jax.ShapeDtypeStruct((B, Sp, H, dh), f32),
-            jax.ShapeDtypeStruct((B, Sp, H, dh), f32),
+            jax.ShapeDtypeStruct((B, H, Sp, dh), f32),
+            jax.ShapeDtypeStruct((B, H, Sp, dh), f32),
         ),
         scratch_shapes=[
             pltpu.VMEM((Sb, dh), f32),
@@ -427,7 +453,11 @@ def _bwd_pallas(q, k_ctx, v_ctx, g, o, lse, seg_q, seg_ctx, W, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp, gp, lse, dcap, segq_p, segc_p)
-    return dq[:, :T], dk[:, :S], dv[:, :S]
+    return (
+        dq.transpose(0, 2, 1, 3)[:, :T],
+        dk.transpose(0, 2, 1, 3)[:, :S],
+        dv.transpose(0, 2, 1, 3)[:, :S],
+    )
 
 
 def _visibility(seg_q, seg_ctx, T: int, S: int, W: int):

@@ -722,15 +722,30 @@ class Learner:
                 # where the feed-path win matters. np.shares_memory is
                 # timing-independent (a mutate-and-read probe raced
                 # jax's async materialization and flaked).
+                # The hazard is the H2D copy from THIS host's buffers, so
+                # the probe must target a process-LOCAL device: under a
+                # multihost mesh, devices.flat[0] can belong to another
+                # process, and reading such an array back raises (killed
+                # the batcher thread in the 2-process test).
+                if self._mesh is None:
+                    target = None
+                else:
+                    local = set(jax.local_devices())
+                    target = next(
+                        (
+                            dev
+                            for dev in self._mesh.devices.flat
+                            if dev in local
+                        ),
+                        jax.local_devices()[0],
+                    )
                 aliased = False
                 for _ in range(8):
                     probe = np.zeros((1 << 20,), np.uint8)
-                    if self._mesh is None:
+                    if target is None:
                         d = jax.device_put(probe)
                     else:
-                        d = jax.device_put(
-                            probe, next(iter(self._mesh.devices.flat))
-                        )
+                        d = jax.device_put(probe, target)
                     jax.block_until_ready(d)
                     aliased |= bool(
                         np.shares_memory(np.asarray(d), probe)
