@@ -728,7 +728,13 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
             ),
             rng=jax.random.key(0),
         )
-        runner.step()  # compile
+        # Warmup WINDOW (compiles on its first dispatch), then the timed
+        # window: through the tunnel the first run() window after compile
+        # under-blocks (measured r4: bogus 300M+ f/s first windows;
+        # windows 1+ agree to ~3%). A quarter-size warmup suffices —
+        # run() ends in block_until_ready, so steady state is reached
+        # before the timed window regardless of warmup length.
+        runner.run(max(1, iters // N // 4))
         out = runner.run(max(1, iters // N))
         key = "env_frames_per_sec" if N == 1 else f"env_frames_per_sec_N{N}"
         result[key] = round(out["frames_per_sec"], 1)
@@ -744,11 +750,11 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
 
 
 # Locked most-promising (E, T, N) configs for the fast capture mode: big E
-# feeds the MXU the largest conv batches; N=8 amortizes dispatch latency
-# (the measured ~24% K=1 overhead on this tunnel). Re-tuned from the CPU
-# profile analysis in NOTES_r04.md; the full-mode sweep stays the source of
-# truth once a long enough tunnel-heal window allows it.
-ANAKIN_PIXELS_LOCKED = ((512, 20, 8), (256, 20, 8))
+# feeds the MXU the largest conv batches. Re-tuned from the r4 full-sweep
+# ON-CHIP capture (BENCH_live.json): N=1 beat N=8 at every (E, T) on the
+# current low-dispatch-latency tunnel (N=8's deeper in-program scan buys
+# nothing and costs flexibility), and T=40 won over T=20.
+ANAKIN_PIXELS_LOCKED = ((512, 40, 1), (512, 20, 1))
 
 
 def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
@@ -788,8 +794,11 @@ def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
             ),
             rng=jax.random.key(0),
         )
-        runner.step()  # compile + warmup
         dispatches = max(2, frames_target // (E * T * N))
+        # Warmup WINDOW (quarter-size; compiles on its first dispatch)
+        # then timed window: the first post-compile run() under-blocks
+        # through the tunnel (see run_bench_anakin).
+        runner.run(max(1, dispatches // 4))
         out = runner.run(dispatches)
         return runner, round(out["frames_per_sec"], 1)
 
