@@ -66,6 +66,13 @@ class ExperimentConfig:
     # attention through the sequence-parallel ops (needs a ('data','seq')
     # mesh — run.py builds one from --dp/--sp; models/transformer.py).
     transformer_attention: str = "dense"
+    # Compute dtype for the transformer CORE's dense-path matmuls —
+    # deliberately separate from compute_dtype (the torso lever):
+    # bfloat16 measured +9-14% at d_model>=512 or T>=256 but -9% at the
+    # small pong_transformer shapes (cast overhead dominates a d256/T20
+    # core; NOTES_r04.md), so it is opt-in, not inherited. Ignored (f32
+    # forced, with a warning) on the sequence-parallel path.
+    transformer_dtype: str = "float32"
     # Shard the unroll's time axis over this many devices (the 'seq' mesh
     # axis); 0 = off. Combined with dp_devices as a ('data','seq') mesh.
     sp_devices: int = 0
@@ -146,6 +153,11 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
             f"unknown compute_dtype {cfg.compute_dtype!r}; "
             "expected 'float32' or 'bfloat16'"
         )
+    if cfg.transformer_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unknown transformer_dtype {cfg.transformer_dtype!r}; "
+            "expected 'float32' or 'bfloat16'"
+        )
     dtype = jnp.dtype(cfg.compute_dtype)
     torso_cls = {
         "mlp": MLPTorso,
@@ -180,6 +192,10 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
         ("num_heads", cfg.transformer_heads),
         ("window", cfg.transformer_window),
         ("dense_kernel", dense_kernel),
+        # Opt-in core compute dtype (cfg.transformer_dtype, NOT
+        # compute_dtype: the small-preset measurement says the torso
+        # lever and the core lever want independent settings).
+        ("dtype", jnp.dtype(cfg.transformer_dtype)),
     )
     if cfg.transformer_attention != "dense":
         if mesh is None:

@@ -62,7 +62,8 @@ class TransformerCoreState(NamedTuple):
 
 def rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
     """Apply rotary embeddings. x `[..., H, Dh]`, positions broadcastable to
-    x's leading dims (`[...]`)."""
+    x's leading dims (`[...]`). Angle math in f32; result in x's dtype (a
+    bf16 x must not silently promote the whole K path to f32)."""
     dh = x.shape[-1]
     half = dh // 2
     freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
@@ -71,7 +72,7 @@ def rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
-    )
+    ).astype(x.dtype)
 
 
 class _Block(nn.Module):
@@ -88,6 +89,9 @@ class _Block(nn.Module):
     d_model: int
     num_heads: int
     mlp_factor: int = 4
+    # Activation/matmul compute dtype (params stay f32; LayerNorms and
+    # softmax run f32 regardless — see TransformerCore.dtype).
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(
@@ -105,8 +109,13 @@ class _Block(nn.Module):
         B, T, D = x.shape
         H = self.num_heads
         dh = D // H
-        h = nn.LayerNorm(name="ln_attn")(x)
-        q = nn.Dense(D, name="q_proj")(h).reshape(B, T, H, dh)
+        # LN stats in f32 for stability; output back in compute dtype.
+        h = nn.LayerNorm(name="ln_attn")(
+            x.astype(jnp.float32)
+        ).astype(self.dtype)
+        q = nn.Dense(D, dtype=self.dtype, name="q_proj")(h).reshape(
+            B, T, H, dh
+        )
         q = rotary(q, q_pos)
         if sp_ctx is not None:
             from torched_impala_tpu.parallel import (
@@ -151,19 +160,30 @@ class _Block(nn.Module):
         else:
             k = k_ctx.reshape(B, -1, H, dh)  # rotary'd at projection
             v = v_ctx.reshape(B, -1, H, dh)
-            logits = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(
-                float(dh)
-            )
+            # bf16 operands ride the MXU fast path; logits accumulate and
+            # softmax in f32 (identical math when dtype is f32).
+            logits = jnp.einsum(
+                "bthd,bshd->bhts",
+                q,
+                k,
+                preferred_element_type=jnp.float32,
+            ) / jnp.sqrt(float(dh))
             logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
             attn = jax.nn.softmax(logits, axis=-1)
             # Fully-masked rows (empty context can't happen: self always
             # visible) — no special case needed.
-            out = jnp.einsum("bhts,bshd->bthd", attn, v).reshape(B, T, D)
-        x = x + nn.Dense(D, name="o_proj")(out)
-        h = nn.LayerNorm(name="ln_mlp")(x)
-        h = nn.Dense(self.mlp_factor * D, name="mlp_in")(h)
+            out = jnp.einsum(
+                "bhts,bshd->bthd", attn.astype(self.dtype), v
+            ).reshape(B, T, D)
+        x = x + nn.Dense(D, dtype=self.dtype, name="o_proj")(
+            out.astype(self.dtype)
+        )
+        h = nn.LayerNorm(name="ln_mlp")(
+            x.astype(jnp.float32)
+        ).astype(self.dtype)
+        h = nn.Dense(self.mlp_factor * D, dtype=self.dtype, name="mlp_in")(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(D, name="mlp_out")(h)
+        x = x + nn.Dense(D, dtype=self.dtype, name="mlp_out")(h)
         return x
 
 
@@ -201,6 +221,13 @@ class TransformerCore(nn.Module):
     # concrete values. Step mode (T=1) always uses einsum: one cached-
     # attention step is too small to pay a kernel launch for.
     dense_kernel: str = "einsum"
+    # Activation/matmul compute dtype for the DENSE path (bfloat16 puts
+    # every projection/MLP/attention matmul on the MXU fast path, the
+    # same lever as the torsos' dtype). Params, LayerNorm statistics,
+    # softmax, the KV-cache STATE, and the core's output stay f32 — so
+    # state layout, checkpoints, and the value/policy heads are
+    # dtype-independent. The SP (ring/ulysses) path always computes f32.
+    dtype: Any = jnp.float32
 
     def initial_state(self, batch_size: int) -> TransformerCoreState:
         B, L, W, D = batch_size, self.num_layers, self.window, self.d_model
@@ -217,9 +244,6 @@ class TransformerCore(nn.Module):
     def __call__(self, features, first, state: TransformerCoreState):
         T, B, _ = features.shape
         W, L, D = self.window, self.num_layers, self.d_model
-        x = nn.Dense(D, name="in_proj")(
-            features.astype(jnp.float32)
-        ).transpose(1, 0, 2)  # [B, T, D]
 
         first = first.transpose(1, 0)  # [B, T]
         # Segment id of each query step: running episode counter + starts
@@ -263,6 +287,24 @@ class TransformerCore(nn.Module):
                     "unroll_length+1); running the dense path",
                     stacklevel=2,
                 )
+        # Compute dtype resolves AFTER the sp decision: the SP ops run
+        # f32 (their collectives and tests are pinned there); the dense
+        # path honors self.dtype. Like the T-shardability fallback above,
+        # a silent override would leave the user believing bf16 is on.
+        cdtype = jnp.float32 if sp else self.dtype
+        if sp and jnp.dtype(self.dtype) != jnp.float32:
+            import warnings
+
+            warnings.warn(
+                f"dtype={jnp.dtype(self.dtype).name} requested but the "
+                f"sequence-parallel ({self.attention!r}) path computes "
+                "f32; the bf16 lever applies to the dense path only",
+                stacklevel=2,
+            )
+        x = nn.Dense(D, dtype=cdtype, name="in_proj")(
+            features.astype(cdtype)
+        ).transpose(1, 0, 2)  # [B, T, D]
+
         if self.dense_kernel not in ("einsum", "pallas"):
             raise ValueError(
                 f"dense_kernel={self.dense_kernel!r}; expected 'einsum' or "
@@ -309,17 +351,23 @@ class TransformerCore(nn.Module):
         for layer in range(L):
             # K/V of current tokens for this layer (cache stores post-
             # rotary keys; values raw).
-            kv_in = nn.LayerNorm(name=f"ln_kv_{layer}")(x)
-            k_new = nn.Dense(D, name=f"k_proj_{layer}")(kv_in)
+            kv_in = nn.LayerNorm(name=f"ln_kv_{layer}")(
+                x.astype(jnp.float32)
+            ).astype(cdtype)
+            k_new = nn.Dense(D, dtype=cdtype, name=f"k_proj_{layer}")(kv_in)
             k_new = rotary(
                 k_new.reshape(B, T, self.num_heads, D // self.num_heads),
                 pos_q,
             ).reshape(B, T, D)
-            v_new = nn.Dense(D, name=f"v_proj_{layer}")(kv_in)
+            v_new = nn.Dense(D, dtype=cdtype, name=f"v_proj_{layer}")(kv_in)
+            # Cache STATE stays f32 (layout contract above); cast the
+            # read side into the compute dtype, the write side back.
             k_ctx = jnp.concatenate(
-                [state.k_cache[:, layer], k_new], axis=1
+                [state.k_cache[:, layer].astype(cdtype), k_new], axis=1
             )  # [B, W+T, D]
-            v_ctx = jnp.concatenate([state.v_cache[:, layer], v_new], axis=1)
+            v_ctx = jnp.concatenate(
+                [state.v_cache[:, layer].astype(cdtype), v_new], axis=1
+            )
             sp_ctx = None
             if sp:
                 sp_ctx = {
@@ -337,13 +385,14 @@ class TransformerCore(nn.Module):
                 d_model=D,
                 num_heads=self.num_heads,
                 mlp_factor=self.mlp_factor,
+                dtype=cdtype,
                 name=f"block_{layer}",
             )(x, k_ctx, v_ctx, mask, pos_q, sp_ctx=sp_ctx,
               pallas_ctx=pallas_ctx)
-            new_k_layers.append(k_ctx[:, -W:])
-            new_v_layers.append(v_ctx[:, -W:])
+            new_k_layers.append(k_ctx[:, -W:].astype(jnp.float32))
+            new_v_layers.append(v_ctx[:, -W:].astype(jnp.float32))
 
-        out = nn.LayerNorm(name="ln_out")(x)
+        out = nn.LayerNorm(name="ln_out")(x.astype(jnp.float32))
 
         combined_seg = jnp.concatenate(
             [state.kv_seg, seg_q], axis=1

@@ -177,7 +177,11 @@ def _fwd_kernel(
         l_scr[...] = alpha * l_scr[...] + jnp.sum(
             p, axis=-1, keepdims=True
         )
-        acc_scr[...] = alpha * acc_scr[...] + _dot(p, v, ((1,), (0,)))
+        # p rides the MXU in v's dtype (bf16 inputs keep bf16 operand
+        # speed — the standard flash trade); accumulation stays f32.
+        acc_scr[...] = alpha * acc_scr[...] + _dot(
+            p.astype(v.dtype), v, ((1,), (0,))
+        )
 
     @pl.when(s == num_s - 1)
     def _emit():
@@ -239,22 +243,25 @@ def _forward(q, k_ctx, v_ctx, seg_q, seg_ctx, W: int, interpret: bool):
     S = k_ctx.shape[1]
     f32 = jnp.float32
 
-    # Kernel layout is [B, H, seq, dh] (see _tile_specs); pad T and S to
-    # the tile grid. Padded context slots carry a sentinel segment
-    # (visible to nothing => explicitly zeroed probability); padded query
-    # rows see no visible context and emit zeros + a finite sentinel lse,
-    # then are sliced off.
+    # Kernel layout is [B, H, seq, dh] (see _tile_specs); operands keep
+    # their input dtype (bf16 inputs keep MXU bf16 operand speed; every
+    # dot accumulates f32 via preferred_element_type and the softmax
+    # recurrence/outputs are f32 regardless). Pad T and S to the tile
+    # grid. Padded context slots carry a sentinel segment (visible to
+    # nothing => explicitly zeroed probability); padded query rows see no
+    # visible context and emit zeros + a finite sentinel lse, then are
+    # sliced off.
     Tb, Tp, Sb, Sp = _block_sizes(T, S)
     qp = jnp.pad(
-        jnp.asarray(q, f32).transpose(0, 2, 1, 3),
+        q.transpose(0, 2, 1, 3),
         ((0, 0), (0, 0), (0, Tp - T), (0, 0)),
     )
     kp = jnp.pad(
-        jnp.asarray(k_ctx, f32).transpose(0, 2, 1, 3),
+        k_ctx.transpose(0, 2, 1, 3),
         ((0, 0), (0, 0), (0, Sp - S), (0, 0)),
     )
     vp = jnp.pad(
-        jnp.asarray(v_ctx, f32).transpose(0, 2, 1, 3),
+        v_ctx.transpose(0, 2, 1, 3),
         ((0, 0), (0, 0), (0, Sp - S), (0, 0)),
     )
     segq_p, segc_p = _pad_segs(seg_q, seg_ctx, Tp, Sp)
@@ -326,7 +333,8 @@ def _dq_kernel(
         )  # [Tb, Sb]
         dp = _dot(g, v, ((1,), (1,)))  # [Tb, Sb]
         ds = p * (dp - dcap_ref[0, 0])
-        dq_scr[...] += _dot(ds, k, ((1,), (0,))) * scale
+        # ds rides the MXU in k's dtype; the accumulator stays f32.
+        dq_scr[...] += _dot(ds.astype(k.dtype), k, ((1,), (0,))) * scale
 
     @pl.when(s == num_s - 1)
     def _emit():
@@ -374,10 +382,12 @@ def _dkv_kernel(
             q, k, segq_ref[0], segc_ref[0], lse_ref[0, 0],
             t * Tb, s_off, scale, W,
         )  # [Tb, Sb]
-        dv_scr[...] += _dot(p, g, ((0,), (0,)))  # [Sb, dh]
+        dv_scr[...] += _dot(
+            p.astype(g.dtype), g, ((0,), (0,))
+        )  # [Sb, dh]
         dp = _dot(g, v, ((1,), (1,)))  # [Tb, Sb]
         ds = p * (dp - dcap_ref[0, 0])
-        dk_scr[...] += _dot(ds, q, ((0,), (0,))) * scale
+        dk_scr[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,))) * scale
 
     @pl.when(t == num_t - 1)
     def _emit():
@@ -391,10 +401,11 @@ def _bwd_pallas(q, k_ctx, v_ctx, g, o, lse, seg_q, seg_ctx, W, interpret):
     B, T, H, dh = q.shape
     S = k_ctx.shape[1]
     f32 = jnp.float32
-    # Kernel layout is [B, H, seq, dh] (see _tile_specs).
+    # Kernel layout is [B, H, seq, dh] (see _tile_specs). Operands keep
+    # their input dtype (see _forward); o is the saved f32 forward
+    # output, g the output cotangent in the primal dtype.
     q, k_ctx, v_ctx, g, o = (
-        jnp.asarray(x, f32).transpose(0, 2, 1, 3)
-        for x in (q, k_ctx, v_ctx, g, o)
+        x.transpose(0, 2, 1, 3) for x in (q, k_ctx, v_ctx, g, o)
     )
     Tb, Tp, Sb, Sp = _block_sizes(T, S)
     pad_t = ((0, 0), (0, 0), (0, Tp - T), (0, 0))

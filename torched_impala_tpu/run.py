@@ -72,6 +72,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=("dense", "ring", "ulysses"), default=None,
                    help="route the transformer core's attention through "
                         "the sequence-parallel ops")
+    p.add_argument("--transformer-dtype",
+                   choices=("float32", "bfloat16"), default=None,
+                   help="transformer core matmul compute dtype (opt-in "
+                        "lever, separate from the torso's compute_dtype: "
+                        "pays at d_model>=512 or T>=256 — docs/SCALING.md)")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator host:port "
                         "(jax.distributed); every host runs this same "
@@ -148,6 +153,7 @@ def build_config(args: argparse.Namespace):
         ("tp", "tp_devices"),
         ("sp", "sp_devices"),
         ("transformer_attention", "transformer_attention"),
+        ("transformer_dtype", "transformer_dtype"),
         ("env_id", "env_id"),
     ):
         v = getattr(args, flag)
