@@ -242,6 +242,7 @@ class TestBF16Compute:
             assert leaf.dtype == jnp.float32, leaf.dtype
 
 
+@pytest.mark.slow
 def test_remat_torso_is_parameter_and_output_transparent():
     """configs.remat_torso wraps the torso in nn.remat: the param tree,
     outputs, AND gradients must be identical to the unwrapped net (so

@@ -10,6 +10,7 @@ the identical loss on both.
 
 import os
 import pathlib
+import pytest
 import socket
 import subprocess
 import sys
@@ -25,6 +26,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_global_mesh_learner_step():
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}

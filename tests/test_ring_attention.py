@@ -64,6 +64,7 @@ class TestEquivalence:
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
         )
 
+    @pytest.mark.slow
     def test_gradients_flow_and_match_dense(self):
         rng = np.random.default_rng(3)
         q, k, v = _qkv(rng, 8)
@@ -85,6 +86,7 @@ class TestEquivalence:
             )
 
 
+    @pytest.mark.slow
     def test_segment_ids_match_dense(self):
         """Episode-boundary masking: random contiguous segments per batch
         row must isolate exactly as in the dense segment-masked oracle
@@ -103,6 +105,7 @@ class TestEquivalence:
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
         )
 
+    @pytest.mark.slow
     def test_segment_gradients_match_dense(self):
         rng = np.random.default_rng(13)
         T = 8
@@ -131,6 +134,7 @@ class TestEquivalence:
             )
 
 
+    @pytest.mark.slow
     def test_prefix_cache_matches_dense(self):
         """The transformer core's KV-cache semantics under SP: a
         strictly-past prefix block (segment-gated, -1 = empty slot) plus

@@ -40,6 +40,7 @@ class TestEquivalence:
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
         )
 
+    @pytest.mark.slow
     def test_matches_ring(self):
         rng = np.random.default_rng(3)
         q, k, v = _qkv(rng, 16, H=8)
@@ -77,6 +78,7 @@ class TestEquivalence:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
             )
 
+    @pytest.mark.slow
     def test_segment_ids_match_dense_and_ring(self):
         """Segment (episode-boundary) masking: Ulysses == dense oracle ==
         ring on the same segmented inputs."""
@@ -99,6 +101,7 @@ class TestEquivalence:
             np.asarray(ring), np.asarray(ref), rtol=2e-4, atol=2e-5
         )
 
+    @pytest.mark.slow
     def test_prefix_cache_matches_dense_and_ring(self):
         """KV-cache prefix under Ulysses: each head group attends its
         slice of the replicated prefix; result == dense oracle == ring."""

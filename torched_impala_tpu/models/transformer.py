@@ -287,18 +287,26 @@ class TransformerCore(nn.Module):
                     "unroll_length+1); running the dense path",
                     stacklevel=2,
                 )
-        # Compute dtype resolves AFTER the sp decision: the SP ops run
-        # f32 (their collectives and tests are pinned there); the dense
-        # path honors self.dtype. Like the T-shardability fallback above,
-        # a silent override would leave the user believing bf16 is on.
-        cdtype = jnp.float32 if sp else self.dtype
-        if sp and jnp.dtype(self.dtype) != jnp.float32:
+        # Compute dtype keys off the CONFIGURED attention mode, not this
+        # call's sp fallback: the SP ops run f32 (their collectives and
+        # tests are pinned there), and if the T=1 actor-step fallback of
+        # an SP-configured core ran bf16 while the learner's SP unroll
+        # ran f32, behaviour and target logits would skew by bf16
+        # rounding inside the V-trace ratios. So an SP-configured core is
+        # f32 EVERYWHERE; the dtype lever applies to dense-configured
+        # cores only. Like the T-shardability fallback above, a silent
+        # override would leave the user believing bf16 is on — warn.
+        sp_configured = self.attention != "dense"
+        cdtype = jnp.float32 if sp_configured else self.dtype
+        if sp_configured and jnp.dtype(self.dtype) != jnp.float32:
             import warnings
 
             warnings.warn(
-                f"dtype={jnp.dtype(self.dtype).name} requested but the "
-                f"sequence-parallel ({self.attention!r}) path computes "
-                "f32; the bf16 lever applies to the dense path only",
+                f"dtype={jnp.dtype(self.dtype).name} requested but "
+                f"attention={self.attention!r} computes f32 on every "
+                "path (incl. the T=1 dense fallback, so actor and "
+                "learner numerics match); the bf16 lever applies to "
+                "dense-configured cores only",
                 stacklevel=2,
             )
         x = nn.Dense(D, dtype=cdtype, name="in_proj")(
