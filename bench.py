@@ -749,12 +749,13 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
     return result
 
 
-# Locked most-promising (E, T, N) configs for the fast capture mode: big E
-# feeds the MXU the largest conv batches. Re-tuned from the r4 full-sweep
-# ON-CHIP capture (BENCH_live.json): N=1 beat N=8 at every (E, T) on the
-# current low-dispatch-latency tunnel (N=8's deeper in-program scan buys
-# nothing and costs flexibility), and T=40 won over T=20.
-ANAKIN_PIXELS_LOCKED = ((512, 40, 1), (512, 20, 1))
+# Locked most-promising (E, T, N) configs for the fast capture mode.
+# Re-tuned from the r4 steady-state full-sweep re-run (BENCH_live.json
+# anakin_pixels, warmup-window protocol): N=1 beat N=8 at every (E, T)
+# on the current low-dispatch-latency tunnel, and with first-window
+# noise removed the program is compute-bound by E=128 — E128_T20 led at
+# 440k with E128_T40 next (426k); larger E buys nothing.
+ANAKIN_PIXELS_LOCKED = ((128, 20, 1), (128, 40, 1))
 
 
 def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
@@ -827,7 +828,7 @@ def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
         # Unroll length at the winning (E, N): T trades per-dispatch compute
         # against update frequency but not frame math (E*T*N per dispatch).
         E, _, N = best[2]
-        for T in (10, 40):
+        for T in (10, 40, 64):
             key = f"E{E}_T{T}_N{N}"
             _, fps = measure(E, T, N)
             result["sweep"][key] = fps
@@ -839,6 +840,47 @@ def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
     result["vs_north_star_62500_per_chip"] = round(best[1] / 62_500.0, 3)
     if fast:
         return result  # no trace capture: every second counts in fast mode
+    # The FLAGSHIP model on-device: deep IMPALA ResNet at pixel shapes
+    # with env stepping fused in (r4 tuning measured 73k env-f/s = 1.17x
+    # the per-chip north-star share — the deep model clears the bar
+    # without any host feeding at all).
+    try:
+        from torched_impala_tpu.models import AtariDeepTorso
+
+        deep_E, deep_T = 256, 20
+        deep_runner = AnakinRunner(
+            agent=Agent(
+                ImpalaNet(
+                    num_actions=4, torso=AtariDeepTorso(dtype=jnp.bfloat16)
+                )
+            ),
+            env=JaxPixelSignal(),
+            optimizer=optax.rmsprop(1e-3, decay=0.99, eps=1e-7),
+            config=AnakinConfig(
+                num_envs=deep_E,
+                unroll_length=deep_T,
+                loss=ImpalaLossConfig(reduction="mean"),
+                updates_per_dispatch=1,
+            ),
+            rng=jax.random.key(0),
+        )
+        deep_runner.run(10)
+        deep = deep_runner.run(40)
+        result["deep_resnet"] = {
+            "E": deep_E,
+            "T": deep_T,
+            "env_frames_per_sec": round(deep["frames_per_sec"], 1),
+            "vs_north_star_62500_per_chip": round(
+                deep["frames_per_sec"] / 62_500.0, 3
+            ),
+        }
+        log(
+            f"bench: anakin pixels deep_resnet E{deep_E} T{deep_T}: "
+            f"{deep['frames_per_sec']:,.0f} env-frames/s"
+        )
+    except Exception as e:
+        result["deep_resnet"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        log(f"bench: anakin deep failed: {type(e).__name__}: {e}")
     # Trace the winner for the round notes (SURVEY.md §6 tracing row).
     try:
         E, T, N = best[2]

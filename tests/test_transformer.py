@@ -516,11 +516,8 @@ class TestBf16Core:
             attention_pallas, "windowed_attention", counting
         )
 
-        def run(kernel):
-            xf = XF + (
-                ("dtype", jnp.bfloat16),
-                ("dense_kernel", kernel),
-            )
+        def run(kernel, dtype=jnp.bfloat16):
+            xf = XF + (("dtype", dtype), ("dense_kernel", kernel))
             net = ImpalaNet(
                 num_actions=3,
                 torso=MLPTorso(hidden_sizes=(16,)),
@@ -563,28 +560,7 @@ class TestBf16Core:
         # per-leaf in global L2. Catches a broken bf16 backward (which
         # produces distances orders of magnitude larger), not rounding.
         monkeypatch.undo()
-        xf32 = XF + (("dtype", jnp.float32), ("dense_kernel", "einsum"))
-        net32 = ImpalaNet(
-            num_actions=3,
-            torso=MLPTorso(hidden_sizes=(16,)),
-            core="transformer",
-            transformer=xf32,
-        )
-        agent32 = Agent(net32)
-        params32 = agent32.init_params(
-            jax.random.key(0), jnp.zeros((4,), jnp.float32)
-        )
-        rng = np.random.default_rng(11)
-        obs = jnp.asarray(rng.normal(size=(6, 2, 4)), jnp.float32)
-        first = jnp.zeros((6, 2), bool).at[0].set(True)
-
-        def loss32(p):
-            out, _ = agent32.unroll(
-                p, obs, first, agent32.initial_state(2)
-            )
-            return jnp.sum(out.policy_logits ** 2)
-
-        gf = jax.grad(loss32)(params32)
+        _, gf = run("einsum", dtype=jnp.float32)
 
         def rel_l2(a, b):
             return float(

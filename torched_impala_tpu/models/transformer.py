@@ -221,12 +221,15 @@ class TransformerCore(nn.Module):
     # concrete values. Step mode (T=1) always uses einsum: one cached-
     # attention step is too small to pay a kernel launch for.
     dense_kernel: str = "einsum"
-    # Activation/matmul compute dtype for the DENSE path (bfloat16 puts
-    # every projection/MLP/attention matmul on the MXU fast path, the
-    # same lever as the torsos' dtype). Params, LayerNorm statistics,
-    # softmax, the KV-cache STATE, and the core's output stay f32 — so
-    # state layout, checkpoints, and the value/policy heads are
-    # dtype-independent. The SP (ring/ulysses) path always computes f32.
+    # Activation/matmul compute dtype for DENSE-configured cores
+    # (bfloat16 puts every projection/MLP/attention matmul on the MXU
+    # fast path, the same lever as the torsos' dtype). Params, LayerNorm
+    # statistics, softmax, the KV-cache STATE, and the core's output stay
+    # f32 — so state layout, checkpoints, and the value/policy heads are
+    # dtype-independent. An SP-configured core (attention="ring"|
+    # "ulysses") IGNORES this and computes f32 on EVERY path — including
+    # its T=1 dense actor-step fallback, so actor and learner numerics
+    # match — and warns if bf16 was requested.
     dtype: Any = jnp.float32
 
     def initial_state(self, batch_size: int) -> TransformerCoreState:
