@@ -1,0 +1,96 @@
+"""Transformer policy solving a memory task a memoryless policy cannot.
+
+JaxDelayedCue shows a one-hot cue ONLY at t=0 and pays +1 iff the action
+at the recall step (6 steps later) matches it: a memoryless policy earns
+1/num_actions = 0.25 in expectation, a policy with temporal memory earns
+1.0. This example trains the sliding-window-KV transformer core
+(models/transformer.py) on it through the public train() API and
+greedy-evals the result — the long-context feature set in miniature.
+
+The same core scales to real long-context work: `dense_kernel="pallas"`
+fuses the attention (ops/attention_pallas.py, engages on TPU backends),
+`dtype=jnp.bfloat16` runs the core's matmuls in bf16 (the MXU lever —
+pays at d_model>=512 or T>=256; see docs/SCALING.md), and
+`attention="ring"|"ulysses"` shards the unroll over a mesh
+(examples/sequence_parallel_attention.py).
+
+Expected output (~1 min on one CPU core): greedy eval ~1.0 vs the 0.25
+memoryless ceiling.
+"""
+
+import os
+import sys
+
+# Make the repo root importable when running the example in place (with a
+# pip-installed package this block is unnecessary; sys.path rather than
+# PYTHONPATH because PYTHONPATH interferes with TPU plugin discovery on
+# some hosts).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # portability; delete on TPU
+
+import numpy as np
+import optax
+
+from torched_impala_tpu.envs import JaxDelayedCue, JaxEnvGymWrapper
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.ops import ImpalaLossConfig
+from torched_impala_tpu.runtime import LearnerConfig
+from torched_impala_tpu.runtime.evaluator import run_episodes
+from torched_impala_tpu.runtime.loop import train
+
+
+def main() -> None:
+    agent = Agent(
+        ImpalaNet(
+            num_actions=4,
+            torso=MLPTorso(hidden_sizes=(32,)),
+            core="transformer",
+            transformer=(
+                ("d_model", 32),
+                ("num_layers", 1),
+                ("num_heads", 2),
+                ("window", 16),  # KV window spans the delay of 6
+            ),
+        )
+    )
+
+    result = train(
+        agent=agent,
+        env_factory=lambda seed, env_index=None: JaxEnvGymWrapper(
+            JaxDelayedCue(), seed=seed
+        ),
+        example_obs=np.zeros(JaxDelayedCue().obs_shape, np.float32),
+        num_actors=2,
+        envs_per_actor=2,
+        learner_config=LearnerConfig(
+            batch_size=8,
+            unroll_length=7,
+            loss=ImpalaLossConfig(reduction="mean"),
+        ),
+        optimizer=optax.rmsprop(3e-3, decay=0.99, eps=1e-7),
+        total_steps=800,
+        seed=0,
+    )
+
+    ev = run_episodes(
+        agent=agent,
+        params=result.learner.params,
+        env=JaxEnvGymWrapper(JaxDelayedCue(), seed=999),
+        num_episodes=100,
+        greedy=True,
+        seed=1,
+    )
+    print(
+        f"greedy eval over 100 episodes: {ev.mean_return:.2f} "
+        f"(memoryless ceiling: 0.25, perfect recall: 1.0)"
+    )
+    assert ev.mean_return > 0.9, "transformer failed to learn the recall"
+
+
+if __name__ == "__main__":
+    main()
