@@ -562,7 +562,7 @@ def run_bench_deep(jax) -> dict:
     }
     def variant(key, label, **fixture_kwargs):
         """One deep-stack variant: build, warm a steady-state window,
-        time, record under `key` (error string on per-variant failure)."""
+        time, record under `key` ({"error": ...} on per-variant failure)."""
         try:
             vfx = _LearnerFixture(
                 jax,

@@ -196,8 +196,18 @@ def _fwd_kernel(
 
 def _block_sizes(T: int, S: int):
     Tb = min(128, _round_up(T, 8))
-    Sb = 128
-    return Tb, _round_up(T, Tb), Sb, _round_up(S, Sb)
+    # Wide S tiles amortize the per-tile mask/iota work and cut grid
+    # iterations: on-chip sweep (r4) measured Sb=512 up to 16% faster fwd
+    # and 35% faster bwd than Sb=128 at T=1024 dense, never slower at the
+    # preset shapes. VMEM stays tiny ([Sb, dh] k/v tiles + [Tb, Sb]
+    # scores ~0.7 MB f32 at dh=64). Sb is chosen as the largest <=512
+    # tile that DIVIDES the 128-padded S — never widening the padding
+    # itself (a naive min(512, ...) cap would pad S=W+T=1152 up to 1536,
+    # +33% matmul work on the windowed long-context shapes).
+    Sp = _round_up(S, 128)
+    n = Sp // 128
+    d = next(d for d in (4, 3, 2, 1) if n % d == 0)
+    return Tb, _round_up(T, Tb), d * 128, Sp
 
 
 def _tile_specs(Tb: int, Sb: int, dh: int, t_inner: bool):
