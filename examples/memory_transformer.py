@@ -14,8 +14,9 @@ pays at d_model>=512 or T>=256; see docs/SCALING.md), and
 `attention="ring"|"ulysses"` shards the unroll over a mesh
 (examples/sequence_parallel_attention.py).
 
-Expected output (~1 min on one CPU core): greedy eval ~1.0 vs the 0.25
-memoryless ceiling.
+Expected output: greedy eval >= 0.8 (typically 1.00) vs the 0.25
+memoryless ceiling — ~1 min on one CPU core, up to ~3 min if the
+nondeterministic actor stream forces the fresh-retry branch.
 """
 
 import os
@@ -90,8 +91,10 @@ def train_and_eval(total_steps: int) -> float:
 
 def main() -> None:
     # Actor threads make the data stream nondeterministic; a missed
-    # 800-step run gets one fresh 1600-step attempt (the same policy the
-    # test suite uses) before concluding anything is wrong.
+    # 800-step run gets one fresh 1600-step attempt before concluding
+    # anything is wrong. Examples are deliberately self-contained, so
+    # this mirrors (rather than imports) the canonical tuning in
+    # tests/test_memory_task.py — change them together.
     score = train_and_eval(800)
     if score < 0.8:
         score = train_and_eval(1600)

@@ -327,3 +327,17 @@ def test_fuzz_random_shapes_fwd_and_grad(trial):
     assert_grads_match_reference(
         case, msg=f"B={B} T={T} H={H} dh={dh} W={W}"
     )
+
+
+def test_block_sizes_never_widen_padding():
+    """The wide-S-tile choice (r4 perf: Sb up to 512) must never inflate
+    the padded context: Sp stays the tight 128-multiple and Sb always
+    divides it — a naive 512 cap padded S=W+T=1152 to 1536 (+33% matmul
+    work on windowed long-context shapes)."""
+    for T in (1, 7, 20, 101, 128, 1024):
+        for S in (1, 20, 128, 149, 256, 640, 1152, 2048, 4096, 4224):
+            Tb, Tp, Sb, Sp = attention_pallas._block_sizes(T, S)
+            tight = attention_pallas._round_up(S, 128)
+            assert Sp == tight, (T, S, Sp, tight)
+            assert Sp % Sb == 0 and 128 <= Sb <= 512, (T, S, Sb, Sp)
+            assert Tp % Tb == 0 and Tb % 8 == 0 and Tp >= T, (T, S)

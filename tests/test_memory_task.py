@@ -110,7 +110,9 @@ def test_transformer_solves_memory_task_memoryless_mlp_cannot():
     theoretically capped at 0.25 expected and measured 0.26. Bars leave
     margin on both sides of the gap. Actor threads make the data stream
     nondeterministic, so a missed 800-step run gets one fresh 1600-step
-    attempt before failing (observed once: pass at 800 on retry)."""
+    attempt before failing (observed once: pass at 800 on retry).
+    examples/memory_transformer.py mirrors this tuning — change them
+    together."""
     transformer_return = _train_and_eval("transformer")
     if transformer_return < 0.8:
         transformer_return = _train_and_eval("transformer", 1600)
