@@ -229,6 +229,7 @@ def main(args) -> None:
                 jax,
                 ks=(8,),
                 single_step_flops=result.get("train_step_gflops", 0.0) * 1e9,
+                include_b64=False,  # fast mode: one compile only
             ),
             gate=tpu_ok,
         )
@@ -682,13 +683,20 @@ def run_bench_remat(jax) -> dict:
     return out
 
 
-def run_bench_fused(jax, ks=(4, 8), single_step_flops: float = 0.0) -> dict:
+def run_bench_fused(
+    jax,
+    ks=(4, 8),
+    single_step_flops: float = 0.0,
+    include_b64: bool = True,
+) -> dict:
     """Fused-dispatch learner throughput (LearnerConfig.steps_per_dispatch):
-    K SGD steps per dispatched XLA program at the headline Pong shapes.
-    Amortizes the fixed per-dispatch host latency (~24% of step wall time
-    through the tunnel, NOTES_r02.md trace analysis) — the measured gap
-    between the 659k f/s in-trace device ceiling and the 502k K=1 number.
-    TPU-only."""
+    K SGD steps per dispatched XLA program. At the B=256 headline shapes
+    the ~10 ms step already hides dispatch latency and fusing COSTS ~12%;
+    at B=64 the ~2.5 ms step sits below the tunnel's ~6.6 ms per-dispatch
+    latency floor and K=8 recovers +58% (190k -> 300k, r4) — the B64_K8
+    config pins the regime where the feature wins (docs/SCALING.md states
+    the decision rule). `include_b64=False` keeps the --fast capture at
+    one compile. TPU-only."""
     import jax.numpy as jnp
 
     from torched_impala_tpu.models import AtariShallowTorso
@@ -697,36 +705,50 @@ def run_bench_fused(jax, ks=(4, 8), single_step_flops: float = 0.0) -> dict:
     # value_fused_best side keys in main() compare like units with `value`.
     n_chips = max(1, len(jax.devices()))
     out = {}
-    for K in ks:
-        fx = _LearnerFixture(
-            jax,
-            torso=AtariShallowTorso(dtype=jnp.bfloat16),
-            num_actions=6,
-            T=20,
-            B=256,
-            fused_k=K,
-        )
-        # Steady-state warmup WINDOW before the timed one (ADVICE r2's
-        # one-step warmup under-read by ~10% through the tunnel; r4
-        # protocol: see run_bench).
-        fx.run_steps(3)
-        dispatches = max(1, 30 // K)
-        fps, dt = fx.timed_frames_per_sec(dispatches)
-        out[f"K{K}"] = round(fps / n_chips, 1)
-        # XLA's cost_analysis counts a scan/while BODY once, not x trip
-        # count (measured live r4: the fused-K=8 executable reports ~1x the
-        # single-step flops, which made the old per-dispatch formula report
-        # MFU/K). The headline section's cost_analysis of the IDENTICAL
-        # model/shapes at K=1 is the reliable per-step count, so prefer it.
-        flops = fx.flops_per_step()
-        per_step = single_step_flops if single_step_flops > 0 else flops
-        if per_step > 0:
-            out[f"K{K}_mfu_estimate"] = round(
-                (per_step * K * dispatches / dt) / 197e12, 4
+    # (key, B, K, warmup, timed dispatches); MFU is only meaningful for
+    # the B=256 configs that share the headline's per-step flop count.
+    configs = [(f"K{K}", 256, K, 3, max(1, 30 // K)) for K in ks]
+    if include_b64:
+        configs.append(("B64_K8", 64, 8, 8, 4))
+    for key, B, K, warmup, dispatches in configs:
+        try:
+            fx = _LearnerFixture(
+                jax,
+                torso=AtariShallowTorso(dtype=jnp.bfloat16),
+                num_actions=6,
+                T=20,
+                B=B,
+                fused_k=K,
             )
-        if flops > 0:
-            out[f"K{K}_costanalysis_gflops"] = round(flops / 1e9, 1)
-        log(f"bench: fused K={K}: {out[f'K{K}']:,.0f} frames/s/chip")
+            # Steady-state warmup WINDOW before the timed one (r4
+            # protocol: see run_bench).
+            fx.run_steps(warmup)
+            fps, dt = fx.timed_frames_per_sec(dispatches)
+            out[key] = round(fps / n_chips, 1)
+            if B == 256:
+                # XLA's cost_analysis counts a scan/while BODY once, not
+                # x trip count (measured r4: the fused-K=8 executable
+                # reports ~1x the single-step flops, which made the old
+                # per-dispatch formula report MFU/K). The headline
+                # section's cost_analysis of the IDENTICAL model/shapes
+                # at K=1 is the reliable per-step count, so prefer it.
+                flops = fx.flops_per_step()
+                per_step = (
+                    single_step_flops if single_step_flops > 0 else flops
+                )
+                if per_step > 0:
+                    out[f"{key}_mfu_estimate"] = round(
+                        (per_step * K * dispatches / dt) / 197e12, 4
+                    )
+                if flops > 0:
+                    out[f"{key}_costanalysis_gflops"] = round(
+                        flops / 1e9, 1
+                    )
+            log(f"bench: fused {key}: {out[key]:,.0f} frames/s/chip")
+        except TimeoutError:
+            raise  # the one-shot wall-clock alarm must reach section()
+        except Exception as e:
+            out[key] = {"error": f"{type(e).__name__}: {e}"[:160]}
     return out
 
 
