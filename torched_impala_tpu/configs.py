@@ -174,16 +174,24 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
 
         torso_cls = nn.remat(torso_cls)
     torso = torso_cls(dtype=dtype)
-    # Dense-path attention math: the fused Pallas kernel on TPU devices,
-    # the einsum elsewhere — resolved HERE against the actual compute
+    # Dense-path attention math, resolved HERE against the actual compute
     # devices (mesh when given, default backend otherwise), mirroring the
     # learner's V-trace 'auto' resolution; the core itself refuses 'auto'.
+    # Shape-aware (r4 measurement): the flash kernel pays when the score
+    # matrix is large — decisively from T*S ~ 1M (1.25-1.46x at T=1024
+    # f32, 2.5x at T=4096 bf16) — but at the preset's T=21, S=149 it is
+    # ~12% SLOWER fwd+bwd than XLA's fused einsum (kernel-launch overhead
+    # over a 3k-element score tile), so small shapes keep the einsum even
+    # on TPU. Threshold 2^18 elements = the measured indifference band.
     from torched_impala_tpu.ops.vtrace import resolve_implementation
 
     devices = None if mesh is None else list(mesh.devices.flat)
+    t_learner = cfg.unroll_length + 1
+    score_elems = t_learner * (cfg.transformer_window + t_learner)
     dense_kernel = (
         "pallas"
         if resolve_implementation("auto", devices) == "pallas"
+        and score_elems >= (1 << 18)
         else "einsum"
     )
     transformer = (

@@ -40,9 +40,10 @@ so the backward, like the forward, touches only O(T+S) HBM per (b, h).
 `D_i = sum_d O_id dO_id` (the softmax-Jacobian row term) is precomputed
 outside the kernels from the saved forward output.
 
-Used by models/transformer.py when `dense_kernel="pallas"` (resolved from
-'auto' against the compute devices in configs.make_agent, like the
-V-trace kernel). The sequence-parallel ring/Ulysses paths are orthogonal:
+Used by models/transformer.py when `dense_kernel="pallas"` (resolved
+from 'auto' in configs.make_agent: TPU devices AND a learner score
+matrix >= 2^18 elements — below that XLA's fused einsum measures faster
+and 'auto' keeps it). The sequence-parallel ring/Ulysses paths are orthogonal:
 they shard S across devices; this kernel accelerates the per-device dense
 math. Capability parity: the reference's CUDA fused attention is the
 analog surface (SURVEY.md §6 long-context row; reconstructed — the
