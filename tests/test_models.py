@@ -297,3 +297,37 @@ def test_remat_torso_is_parameter_and_output_transparent():
         grads[0],
         grads[1],
     )
+
+
+def test_pixel_rescale_fold_matches_explicit_division():
+    """The first-conv 1/255 fold (torsos._first_conv_rescaled — the r4
+    copy.8 layout-transpose fix) must be numerically the same transform
+    as dividing the input: feeding uint8 through the fold equals feeding
+    the explicitly normalized float input through the same params."""
+    import numpy as np
+
+    from torched_impala_tpu.models import AtariDeepTorso, AtariShallowTorso
+
+    rng = np.random.default_rng(3)
+    obs_u8 = jnp.asarray(
+        rng.integers(0, 256, size=(6, 84, 84, 4), dtype=np.uint8)
+    )
+    obs_f32 = obs_u8.astype(jnp.float32) / 255.0
+    # f32 tight; bf16 (the shipped compute dtype the fold was built for)
+    # loose — the pre-rescale conv outputs are 255x larger, so bf16
+    # rounding differs more than the f32 path's.
+    for dtype, rtol, atol in (
+        (jnp.float32, 1e-4, 1e-4),
+        (jnp.bfloat16, 0.08, 0.08),
+    ):
+        for cls in (AtariShallowTorso, AtariDeepTorso):
+            torso = cls(dtype=dtype)
+            params = torso.init(jax.random.key(0), obs_u8)
+            out_fold = torso.apply(params, obs_u8)  # uint8 -> folded
+            out_ref = torso.apply(params, obs_f32)  # float -> plain
+            np.testing.assert_allclose(
+                np.asarray(out_fold, np.float32),
+                np.asarray(out_ref, np.float32),
+                rtol=rtol,
+                atol=atol,
+            )
