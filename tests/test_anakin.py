@@ -119,7 +119,7 @@ def test_conv_policy_learns_pixels_on_device():
     from torched_impala_tpu.envs import JaxPixelSignal
     from torched_impala_tpu.models import AtariShallowTorso
 
-    env = JaxPixelSignal(size=16, channels=1, episode_len=10)
+    env = JaxPixelSignal(size=36, channels=1, episode_len=10)
     runner = AnakinRunner(
         agent=Agent(
             ImpalaNet(num_actions=4, torso=AtariShallowTorso())
@@ -154,7 +154,7 @@ def test_sharded_conv_pixels_runs():
         agent=Agent(
             ImpalaNet(num_actions=4, torso=AtariShallowTorso())
         ),
-        env=JaxPixelSignal(size=16, channels=1, episode_len=6),
+        env=JaxPixelSignal(size=36, channels=1, episode_len=6),
         optimizer=optax.sgd(1e-3),
         config=AnakinConfig(num_envs=8, unroll_length=4),
         rng=jax.random.key(0),

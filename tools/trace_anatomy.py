@@ -1,0 +1,110 @@
+"""Per-op device-time anatomy of a jax.profiler trace.
+
+Round-4's headline anatomy (NOTES_r04.md §"Headline trace anatomy") was
+parsed by hand; this makes the method repeatable: point it at a profiler
+trace dir (the newest `plugins/profile/<ts>/` capture inside), and it
+prints mean device time per XLA op per step, sorted, with the step count
+inferred from the top-level module activity.
+
+Usage:
+    python tools/trace_anatomy.py traces/bench [--steps N] [--top K]
+
+The trace.json.gz "traceEvents" carry one event per op execution with
+`dur` in microseconds; device-stream events are identified by their PID's
+process name containing "TPU" / "/device:". Ops are aggregated by name
+across the capture and divided by the step count (events of the
+outermost jit program).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def newest_capture(trace_dir: str) -> str:
+    pats = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "plugins", "profile", "*", "*trace.json.gz")
+        )
+    )
+    if not pats:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    return max(pats, key=os.path.getmtime)
+
+
+def load_events(path: str) -> dict:
+    with gzip.open(path, "rt") as f:
+        return json.load(f)
+
+
+def device_pids(doc: dict) -> set:
+    """PIDs whose process_name metadata looks like a device stream."""
+    pids = set()
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = (ev.get("args") or {}).get("name", "")
+            low = name.lower()
+            if "tpu" in low or "/device:" in low or "xla" in low:
+                pids.add(ev["pid"])
+    return pids
+
+
+def anatomy(path: str):
+    doc = load_events(path)
+    pids = device_pids(doc)
+    per_op = collections.Counter()
+    per_op_n = collections.Counter()
+    # Step count: the outermost program shows up as the op with the
+    # longest single durations and equal count per step; we take the
+    # most common count among the top-duration ops when no hint given.
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X" or ev.get("pid") not in pids:
+            continue
+        name = ev.get("name", "?")
+        dur = float(ev.get("dur", 0.0))
+        per_op[name] += dur
+        per_op_n[name] += 1
+    return per_op, per_op_n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="steps in the capture (default: modal op count)")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args(argv)
+
+    path = newest_capture(args.trace_dir)
+    print(f"# capture: {path}")
+    per_op, per_op_n = anatomy(path)
+    if not per_op:
+        print("no device events found", file=sys.stderr)
+        return 1
+
+    steps = args.steps
+    if steps is None:
+        # Modal event count across the 20 most expensive ops — each real
+        # per-step op executes exactly once per step.
+        counts = [per_op_n[k] for k, _ in per_op.most_common(20)]
+        steps = collections.Counter(counts).most_common(1)[0][0]
+    total_us = sum(per_op.values())
+    print(f"# steps inferred: {steps}; total device-op time "
+          f"{total_us / 1e3:.2f} ms -> {total_us / steps / 1e3:.3f} ms/step")
+    print(f"{'op':48s} {'ms/step':>9s} {'share':>7s} {'n':>5s}")
+    for name, us in per_op.most_common(args.top):
+        print(
+            f"{name[:48]:48s} {us / steps / 1e3:9.3f} "
+            f"{us / total_us:6.1%} {per_op_n[name]:5d}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,26 +21,136 @@ import jax
 import jax.numpy as jnp
 
 
-def _first_conv_rescaled(conv: nn.Conv, x: jax.Array, dtype) -> jax.Array:
-    """First conv over pixel input with the 1/255 normalize FOLDED past it:
-    conv(x/255, w) + b == (conv(x, w) + b - b)/255 + b, with b recovered
-    as conv(zeros) (an all-zero window at every position => pure bias;
-    XLA constant-folds it to a broadcast).
+class _FirstPixelConv(nn.Module):
+    """First conv over pixel observations, with two TPU-shaped rewrites.
+    Parameter tree is bit-identical to the `nn.Conv` it replaces (same
+    `kernel`/`bias` names, shapes, f32 param dtype, and initializers), so
+    checkpoints and the TP `model_shardings` are unaffected.
 
-    Why: a bare uint8->dtype convert sinks into the conv's input fusion,
-    so XLA's layout transpose of the observation batch (the headline
-    trace's copy.8 — 12% of the train step at [T+1,B,84,84,4]) runs on
-    1-byte elements; the old input-side /255 materialized the normalized
-    tensor BEFORE the transpose, doubling (bf16) or quadrupling (f32)
-    the copy traffic. Measured on-chip (r4): headline 514-579k ->
-    577-586k f/s. Exact up to dtype rounding, parameter-tree identical —
-    pinned by tests/test_models.py."""
-    was_uint8 = x.dtype == jnp.uint8
-    y = conv(x.astype(dtype))
-    if not was_uint8:
-        return y
-    b = conv(jnp.zeros((1, 1, 1, x.shape[-1]), dtype))[0, 0, 0]
-    return (y - b) * jnp.asarray(1 / 255.0, dtype) + b
+    1. **Kernel-side 1/255 fold** (uint8 inputs only):
+       `conv(x/255, w) == conv(x, w/255)`, so the normalize is one f32
+       multiply on the 8 KB kernel instead of a pass over the obs batch.
+       The bare uint8->dtype convert then sinks into the conv's input
+       fusion and XLA's obs layout transpose (the r4 headline trace's
+       copy.8 — 12% of the train step) runs on 1-byte elements.
+       Activations stay in the normalized range, so bf16 rounding is
+       normal (the r4 output-side fold ran the conv on 0..255 inputs and
+       needed 0.08-loose pinning; this fold is tight — tests/test_models).
+
+    2. **Space-to-depth** (strided first conv, `kernel % stride == 0`):
+       a kh x kw / stride-s conv over C channels is algebraically the
+       same sum as a (kh/s x kw/s) / stride-1 conv over s*s*C channels
+       of s x s pixel blocks. For the Nature-CNN 8x8/4 first layer this
+       turns a C_in=4 contraction (4/128 MXU lane utilization; the dW
+       pass alone was 22% of the r5 headline trace) into C_in=64.
+       Input repack is a pure reshape/transpose on uint8 bytes; kernel
+       repack is free (8 KB, constant-folded).
+    """
+
+    features: int
+    kernel_size: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.features),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+        if x.dtype == jnp.uint8:
+            kernel = kernel * (1.0 / 255.0)
+        lead, (h, w) = x.shape[:-3], x.shape[-3:-1]
+        xb = x.reshape(-1, h, w, cin)
+        # Space-to-depth only understands the two string conventions; an
+        # explicit pad-pair (or CIRCULAR etc.) routes to the plain conv.
+        s2d = (
+            self.padding in ("SAME", "VALID")
+            and sh == sw
+            and sh > 1
+            and kh % sh == 0
+            and kw % sw == 0
+        )
+        if s2d:
+            y = self._s2d_conv(xb, kernel)
+        else:
+            y = jax.lax.conv_general_dilated(
+                xb.astype(self.dtype),
+                kernel.astype(self.dtype),
+                (sh, sw),
+                self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+        y = y + bias.astype(self.dtype)
+        return y.reshape(*lead, *y.shape[1:])
+
+    def _s2d_conv(self, x: jax.Array, kernel: jax.Array) -> jax.Array:
+        """Strided conv as a stride-1 conv over pixel blocks.
+
+        VALID windows start at multiples of s, so the used input extent
+        (out-1)*s + kh is block-aligned (s | kh) — no pixel movement
+        beyond an edge trim. SAME needs an explicit low/high pad first
+        (XLA's split: low = total // 2); the padded extent is likewise
+        always a multiple of s.
+        """
+        n, h, w, cin = x.shape
+        kh, kw = self.kernel_size
+        s = self.strides[0]
+        if self.padding != "VALID":
+            # SAME: explicit low/high pad to the block-aligned extent
+            # first (XLA's split: low = total // 2), then the same
+            # reshape applies.
+            out_h, out_w = -(-h // s), -(-w // s)
+            pad_h = max((out_h - 1) * s + kh - h, 0)
+            pad_w = max((out_w - 1) * s + kw - w, 0)
+            x = jnp.pad(
+                x,
+                (
+                    (0, 0),
+                    (pad_h // 2, pad_h - pad_h // 2),
+                    (pad_w // 2, pad_w - pad_w // 2),
+                    (0, 0),
+                ),
+            )
+        else:
+            # VALID: trim the unused remainder so the extent is
+            # block-aligned (windows start at multiples of s and
+            # (out-1)*s + kh is a multiple of s).
+            out_h, out_w = (h - kh) // s + 1, (w - kw) // s + 1
+            x = x[:, : (out_h - 1) * s + kh, : (out_w - 1) * s + kw, :]
+        hp, wp = x.shape[1:3]
+        # Splitting each spatial axis into (blocks, s) is a PURE RESHAPE
+        # (row-major split) — no transpose, no data movement. The conv
+        # then runs with FOUR spatial dims: (block_h, in_h, block_w,
+        # in_w) with window (kh/s, s, kw/s, s) and stride 1; the two
+        # intra-block dims contract to extent 1. Output position
+        # (I, J) covers pixels (s*I + ki, s*J + kj), ki = s*pi + bi —
+        # exactly the strided conv. XLA's TPU conv emitters handle the
+        # blocked layout internally; the r5 trace showed the explicit
+        # blocks-to-channels transpose costing 2.4 ms/step of pure u8
+        # data movement that this formulation deletes.
+        xs = x.reshape(n, hp // s, s, wp // s, s, cin)
+        ws = kernel.reshape(kh // s, s, kw // s, s, cin, self.features)
+        dn = jax.lax.conv_dimension_numbers(
+            xs.shape, ws.shape, ("NHXWYC", "HXWYIO", "NHXWYC")
+        )
+        y = jax.lax.conv_general_dilated(
+            xs.astype(self.dtype),
+            ws.astype(self.dtype),
+            (1, 1, 1, 1),
+            "VALID",
+            dimension_numbers=dn,
+        )
+        return y.reshape(n, out_h, out_w, self.features)
 
 
 class MLPTorso(nn.Module):
@@ -59,21 +169,46 @@ class MLPTorso(nn.Module):
 
 
 class AtariShallowTorso(nn.Module):
-    """Nature-CNN: 3 convs + Dense(512) (analog `haiku_nets.py:57-76`)."""
+    """Nature-CNN: 3 VALID convs + Dense(512) (analog `haiku_nets.py:57-76`,
+    which pins `padding='VALID'` per the DQN paper: 84 -> 20 -> 9 -> 7,
+    flatten 3136). Rounds 1-4 ran flax's default SAME here (21 -> 11 ->
+    11, flatten 7744) — a silent 2x over-compute vs the cited spec;
+    fixed in r5 (param shapes changed: Dense_0 kernel 7744 -> 3136)."""
 
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = nn.relu(
-            _first_conv_rescaled(
-                nn.Conv(32, (8, 8), strides=(4, 4), dtype=self.dtype),
-                x,
-                self.dtype,
-            )
+            _FirstPixelConv(
+                32,
+                (8, 8),
+                strides=(4, 4),
+                padding="VALID",
+                dtype=self.dtype,
+                name="Conv_0",
+            )(x)
         )
-        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), dtype=self.dtype)(x))
-        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), dtype=self.dtype)(x))
+        x = nn.relu(
+            nn.Conv(
+                64,
+                (4, 4),
+                strides=(2, 2),
+                padding="VALID",
+                dtype=self.dtype,
+                name="Conv_1",
+            )(x)
+        )
+        x = nn.relu(
+            nn.Conv(
+                64,
+                (3, 3),
+                strides=(1, 1),
+                padding="VALID",
+                dtype=self.dtype,
+                name="Conv_2",
+            )(x)
+        )
         x = x.reshape(*x.shape[:-3], -1)
         return nn.relu(nn.Dense(512, dtype=self.dtype)(x))
 
@@ -105,14 +240,17 @@ class AtariDeepTorso(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        first = True
-        for channels in self.channel_sections:
-            conv = nn.Conv(channels, (3, 3), dtype=self.dtype)
-            if first:
-                x = _first_conv_rescaled(conv, x, self.dtype)
-                first = False
+        for i, channels in enumerate(self.channel_sections):
+            if i == 0:
+                # Stride-1 3x3: no space-to-depth; still gets the
+                # kernel-side 1/255 fold for uint8 pixels.
+                x = _FirstPixelConv(
+                    channels, (3, 3), dtype=self.dtype, name="Conv_0"
+                )(x)
             else:
-                x = conv(x)
+                x = nn.Conv(
+                    channels, (3, 3), dtype=self.dtype, name=f"Conv_{i}"
+                )(x)
             x = nn.max_pool(
                 x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
             )
