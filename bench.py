@@ -277,6 +277,39 @@ def main(args) -> None:
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
     section("stack_reuse_compare", run_stack_reuse_compare)
+    # Per-core host-path product answer (VERDICT r4 missing #4): combine
+    # the integrated CPU drain (ring OFF — aliasing) with the ring +
+    # simulated-H2D arm (the path a copying-H2D production host runs)
+    # into one self-describing verdict against the 1.85 GB/s/chip bar.
+    feeder = result.get("feeder_saturation", {})
+    srcmp = result.get("stack_reuse_compare", {})
+    required = None
+    ring_arm = None
+    if isinstance(feeder, dict):
+        required = feeder.get("required_GBps_per_chip_62500fps")
+    ring_arm_shape = None
+    if isinstance(srcmp, dict):
+        # Worst case across measured shapes (self-described below).
+        arms = [
+            (v["reuse_plus_sim_h2d_GBps"], k)
+            for k, v in srcmp.items()
+            if isinstance(v, dict) and "reuse_plus_sim_h2d_GBps" in v
+        ]
+        if arms:
+            ring_arm, ring_arm_shape = min(arms)
+    if required and ring_arm:
+        result["host_path_ceiling"] = {
+            "required_GBps_per_chip": required,
+            "ring_stack_plus_sim_h2d_GBps_one_core": ring_arm,
+            "measured_at_shape": ring_arm_shape,  # min across shapes
+            "cores_per_chip_required": round(required / ring_arm, 2),
+            "note": (
+                "ring+sim-H2D arm = queue->ring-stack->copying transfer "
+                "on ONE core; the integrated drain_cpu_* rows lower-bound "
+                "it (CPU device_put aliasing disables the ring there)"
+            ),
+        }
+        write_partial()
     # Stays partial if the alarm skipped anything OR the headline errored:
     # tunnel_watch.sh promotes only `"partial": false` runs to
     # BENCH_live.json and stops watching, so a capture missing its
@@ -343,6 +376,7 @@ class _LearnerFixture:
         from torched_impala_tpu.runtime import Learner, LearnerConfig
 
         self.jax, self.T, self.B, self.K = jax, T, B, fused_k
+        self.grad_accum = grad_accum
         # num_tasks > 1 = the DMLab-30 stack: multi-task value head +
         # PopArt normalization (BASELINE config 5).
         agent = Agent(
@@ -425,7 +459,14 @@ class _LearnerFixture:
         return self.T * self.B * self.K * steps / dt, dt
 
     def flops_per_step(self) -> float:
-        """XLA's algebraic FLOP count for one compiled step (0 if absent)."""
+        """XLA's algebraic FLOP count for one compiled step (0 if absent).
+
+        Raw cost_analysis: counts every `lax.scan`/`while` BODY once, not
+        x trip count — so it under-counts grad-accum programs by ~accum
+        and fused-K programs by ~K. Use `canonical_flops_per_step` for
+        MFU math; this raw value is only right for accum == 1 programs
+        (per-dispatch, not per-SGD-step, at fused K > 1).
+        """
         try:
             cost = self.step_fn.cost_analysis()
             if isinstance(cost, (list, tuple)):
@@ -434,6 +475,25 @@ class _LearnerFixture:
         except Exception as e:
             log(f"bench: cost_analysis unavailable: {type(e).__name__}: {e}")
             return 0.0
+
+    def canonical_flops_per_step(self) -> float:
+        """FLOPs for ONE full-batch SGD step, under ONE convention usable
+        across plain/fused/accum/remat variants of the same config
+        (VERDICT r4 weak #3: the accum arm reported MFU/accum).
+
+        - grad_accum: the accum scan body (one microbatch fwd+bwd) is
+          counted once by cost_analysis, so multiply by accum. The
+          optimizer-update flops get overcounted (accum-1) extra times,
+          a <1% error at these model sizes (pinned ~10% by
+          tests/test_bench_units.py).
+        - fused K: the K-step body is likewise counted once, and one
+          body IS one full SGD step — no correction; callers divide
+          wall time by K dispatch-steps instead.
+        - remat: recompute flops are real executed work but NOT model
+          flops; MFU convention divides MODEL flops by time, so remat
+          arms should prefer the plain arm's count when available.
+        """
+        return self.flops_per_step() * self.grad_accum
 
     def temp_bytes(self) -> int:
         """Compiled executable's temp (activation) HBM allocation; 0 if
@@ -507,7 +567,7 @@ def run_bench(jax, tpu_ok: bool) -> dict:
         result["profile_trace_dir"] = trace_dir
     # Rough MFU vs the v5e bf16 peak (197 TFLOP/s/chip): XLA counts
     # algebraic flops, not MXU-padded ones.
-    flops = fx.flops_per_step()
+    flops = fx.canonical_flops_per_step()
     if flops > 0:
         result["train_step_gflops"] = round(flops / 1e9, 2)
         if tpu_ok:
@@ -598,7 +658,7 @@ def run_bench_deep(jax) -> dict:
     variant(
         "frames_per_sec_per_chip_B128", "B=128", num_actions=4, B=128
     )
-    flops = fx.flops_per_step()
+    flops = fx.canonical_flops_per_step()
     if flops > 0:
         out["train_step_gflops"] = round(flops / 1e9, 2)
         out["mfu_estimate"] = round((flops * steps / dt) / 197e12, 4)
@@ -645,6 +705,11 @@ def run_bench_remat(jax) -> dict:
     T, B, steps = 20, 64, 15
     plain = AtariDeepTorso(dtype=jnp.bfloat16)
     remat = nn.remat(AtariDeepTorso)(dtype=jnp.bfloat16)
+    # ONE FLOPs model for every arm (VERDICT r4 weak #3: per-arm raw
+    # cost_analysis gave accum4 MFU/4 and would credit remat's recompute
+    # as model flops): the plain arm's count is canonical; arms that run
+    # before/without it fall back to their own accum-corrected count.
+    canonical_flops = 0.0
     for key, torso, accum in (
         ("plain", plain, 1),
         ("remat", remat, 1),
@@ -662,10 +727,15 @@ def run_bench_remat(jax) -> dict:
             fx.run_steps(6)  # steady-state warmup window (r4 protocol)
             fps, dt = fx.timed_frames_per_sec(steps)
             entry = {"frames_per_sec": round(fps, 1)}
-            flops = fx.flops_per_step()
+            if key == "plain":
+                canonical_flops = fx.canonical_flops_per_step()
+            flops = canonical_flops or fx.canonical_flops_per_step()
             if flops > 0:
                 entry["mfu_estimate"] = round(
                     (flops * steps / dt) / 197e12, 4
+                )
+                entry["mfu_flops_source"] = (
+                    "plain" if canonical_flops else "self_accum_corrected"
                 )
             tb = fx.temp_bytes()
             if tb:
@@ -986,20 +1056,32 @@ def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
 
 def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
     """Host-feed ceiling WITHOUT env stepping (VERDICT r2 item 4, r3
-    item 3): feeder threads replay precomputed per-unroll Trajectories at
-    maximum rate through the REAL Learner ingest path — host queue ->
-    batcher thread stacking B unrolls -> device_put -> bounded device
-    queue. Two modes per (B, K) config:
+    item 3, r4 weak #1): feeder threads replay precomputed per-unroll
+    Trajectories at maximum rate through the REAL Learner ingest path —
+    host queue -> batcher thread stacking B unrolls -> device_put ->
+    bounded device queue. Modes per (B, K) config:
 
-    - drain: batches are pulled straight off the device queue with NO
-      train step — the pure feed-path ceiling, valid on any backend
-      (chip-independent: stacking + device_put are host work). THE number
-      the host-actor architecture stands on: at ~29.7 KB/frame, the
-      62.5k frames/s/chip north-star pace needs ~1.9 GB/s of sustained
-      ingest and the 502k headline ~15 GB/s (see required_* keys).
-    - train: the r2-era mode (feed + real train step + batch_wait_frac),
-      kept on the TPU backend where the step is fast enough to probe
-      whether compute or feed binds first."""
+    - drain_cpu: batches pulled straight off the device queue with NO
+      train step, device_put targeted at the LOCAL CPU backend
+      (LearnerConfig.data_device) — the host-work ceiling of the path.
+      Caveat it self-reports: jax CPU device_put may zero-copy ALIAS,
+      so the ring-reuse stacking auto-disables here; the reuse win is
+      measured separately (stack_reuse_compare, incl. a simulated-H2D
+      arm), and `host_path_ceiling` below combines the two into the
+      per-core product answer.
+    - drain (TPU backends): same path to the default device. On THIS
+      rig that crosses a network tunnel, so it measures the tunnel, not
+      host work or production PCIe H2D — the r4 capture recorded 826
+      f/s here without saying so and contradicted the notes' CPU-run
+      table by ~100x. Every entry now records `device_put_target` and
+      `route`.
+    - train: feed + real train step + batch_wait_frac, TPU only (probes
+      whether compute or feed binds first ON THIS RIG; through the
+      tunnel the answer reflects tunnel latency too).
+
+    THE number the host-actor architecture stands on: at ~29.7 KB/frame,
+    the 62.5k frames/s/chip north-star pace needs ~1.9 GB/s of sustained
+    ingest per chip (see required_* keys)."""
     import threading
 
     import jax.numpy as jnp
@@ -1042,7 +1124,13 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
         )
     )
 
-    def measure(B: int, K: int, steps: int, drain_only: bool = False) -> dict:
+    def measure(
+        B: int,
+        K: int,
+        steps: int,
+        drain_only: bool = False,
+        data_device: str | None = None,
+    ) -> dict:
         learner = Learner(
             agent=Agent(
                 ImpalaNet(
@@ -1059,6 +1147,7 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
                 loss=ImpalaLossConfig(reduction="sum"),
                 publish_interval=1_000_000,
                 steps_per_dispatch=K,
+                data_device=data_device,
             ),
             example_obs=np.zeros((84, 84, 4), np.uint8),
             rng=jax.random.key(0),
@@ -1110,6 +1199,13 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
             for th in feeders:
                 th.join(timeout=10)
         frames = T * B * K * steps
+        # Self-description (VERDICT r4 weak #1): WHERE did device_put
+        # land, and did the transfer cross this rig's network tunnel?
+        target = (
+            jax.local_devices(backend=data_device)[0]
+            if data_device
+            else jax.devices()[0]
+        )
         entry = {
             "frames_per_sec": round(frames / dt, 1),
             "ingest_MB_per_sec": round(
@@ -1119,6 +1215,17 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
             # Whether the ring-reuse stacking path engaged (auto-resolved
             # by the aliasing probe; the big lever at large B).
             "stack_reuse": bool(learner._stack_reuse),
+            "device_put_target": str(target),
+            "route": (
+                "local_host_memory"
+                if target.platform == "cpu"
+                else (
+                    "tunnelled_tpu_NOT_representative_of_PCIe_H2D"
+                    if "axon" in os.environ.get("JAX_PLATFORMS", "")
+                    or "axon" in os.environ.get("PYTHONPATH", "")
+                    else "device_default"
+                )
+            ),
         }
         if wait_frac is not None:
             # Fraction of learner wall-time spent waiting on the batcher:
@@ -1142,15 +1249,41 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
             1_000_000 * bytes_per_frame / 1e9, 2
         ),
     }
-    # Drain sweep (chip-independent): B x K grid, steps sized so each
-    # config moves >=60MB of unrolls — enough to amortize warmup on this
-    # 1-core box without starving the wall-clock alarm.
+    # CPU-backend drain sweep (host work only — the chip-independent
+    # claim, now actually true): B x K grid, steps sized so each config
+    # moves >=60MB of unrolls — enough to amortize warmup on this 1-core
+    # box without starving the wall-clock alarm. Needs a local CPU
+    # backend alongside the default one (resolve_tpu_env arranges
+    # "axon,cpu"); degrades to the default backend when absent.
+    try:
+        jax.local_devices(backend="cpu")
+        cpu_dev = "cpu"
+    except Exception:
+        cpu_dev = None
     for B in (8, 64, 256):
         for K in (1, 4):
+            steps = max(3, 4096 // (B * K))
+            key = f"drain_cpu_B{B}_K{K}"
+            out[key] = measure(
+                B, K, steps, drain_only=True, data_device=cpu_dev
+            )
+            log(f"bench: feeder {key}: {out[key]}")
+    # The same drain against the DEFAULT device — on this rig that is
+    # the tunnelled TPU, so this row measures the tunnel route (each
+    # entry's `route` key says so); kept because batch_wait_frac in the
+    # train rows below is bounded by it.
+    if tpu_ok:
+        for B, K in ((8, 1), (256, 1)):
             steps = max(3, 4096 // (B * K))
             key = f"drain_B{B}_K{K}"
             out[key] = measure(B, K, steps, drain_only=True)
             log(f"bench: feeder {key}: {out[key]}")
+    # The per-core product answer (VERDICT r4 missing #4): the integrated
+    # CPU drain above runs WITHOUT ring reuse (device_put aliasing on the
+    # CPU backend disables it), so it lower-bounds the host path; the
+    # ring + simulated-H2D-copy arm of stack_reuse_compare measures the
+    # reuse path a production (copying-H2D) host runs. main() combines
+    # both into `host_path_ceiling` next to required_GBps_per_chip.
     # Feed + train (TPU only: on CPU the train step dominates and the
     # number is uninformative — r3's B8 config measured the CPU step, not
     # the feed).
@@ -1385,12 +1518,28 @@ def run_stack_reuse_compare() -> dict:
         reuse_ms = timeit(
             lambda i: stack_trajectories(trajs, out=ring[i % 2])
         )
+        # Ring stacking + an explicit copy of the stacked obs into a
+        # second preallocated buffer — a stand-in for a production
+        # host's copying H2D (pinned-staging memcpy; the DMA itself is
+        # hardware). The integrated CPU drain can't show this arm
+        # because jax CPU device_put aliases (ring auto-disables); this
+        # is the honest per-core estimate of the path a real TPU host
+        # runs: queue -> ring-stack -> copying transfer.
+        staging = [np.empty_like(ring[0].obs) for _ in range(2)]
+
+        def reuse_plus_copy(i):
+            stack_trajectories(trajs, out=ring[i % 2])
+            np.copyto(staging[i % 2], ring[i % 2].obs)
+
+        reuse_h2d_ms = timeit(reuse_plus_copy)
         key = f"T{T}_B{B}_{mb:.0f}MB"
         out[key] = {
             "fresh_ms": round(fresh_ms, 2),
             "reuse_ms": round(reuse_ms, 2),
             "reuse_speedup": round(fresh_ms / reuse_ms, 2),
             "reuse_GBps": round(mb / reuse_ms, 2),
+            "reuse_plus_sim_h2d_ms": round(reuse_h2d_ms, 2),
+            "reuse_plus_sim_h2d_GBps": round(mb / reuse_h2d_ms, 2),
         }
         log(f"bench: stack reuse {key}: {out[key]}")
     return out

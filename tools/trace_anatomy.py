@@ -56,21 +56,31 @@ def device_pids(doc: dict) -> set:
 
 
 def anatomy(path: str):
+    """Returns (per_op dur-sums us, per_op counts, module dur-sum us,
+    module count). Container events — the outermost jit module (name
+    starts with "jit") and the pid-level numbered step rows (bare
+    integers, one per step) — are split out of per_op: counting them as
+    ops double-counts the total and deflates every real op's share."""
     doc = load_events(path)
     pids = device_pids(doc)
     per_op = collections.Counter()
     per_op_n = collections.Counter()
-    # Step count: the outermost program shows up as the op with the
-    # longest single durations and equal count per step; we take the
-    # most common count among the top-duration ops when no hint given.
+    module_us = 0.0
+    module_n = 0
     for ev in doc.get("traceEvents", []):
         if ev.get("ph") != "X" or ev.get("pid") not in pids:
             continue
         name = ev.get("name", "?")
         dur = float(ev.get("dur", 0.0))
+        if name.startswith("jit"):
+            module_us += dur
+            module_n += 1
+            continue
+        if name.isdigit():  # per-step marker rows, not ops
+            continue
         per_op[name] += dur
         per_op_n[name] += 1
-    return per_op, per_op_n
+    return per_op, per_op_n, module_us, module_n
 
 
 def main(argv=None):
@@ -83,20 +93,27 @@ def main(argv=None):
 
     path = newest_capture(args.trace_dir)
     print(f"# capture: {path}")
-    per_op, per_op_n = anatomy(path)
+    per_op, per_op_n, module_us, module_n = anatomy(path)
     if not per_op:
         print("no device events found", file=sys.stderr)
         return 1
 
     steps = args.steps
     if steps is None:
-        # Modal event count across the 20 most expensive ops — each real
-        # per-step op executes exactly once per step.
-        counts = [per_op_n[k] for k, _ in per_op.most_common(20)]
-        steps = collections.Counter(counts).most_common(1)[0][0]
+        # The module (outer jit program) runs exactly once per step;
+        # fall back to the modal op count if no module event exists.
+        if module_n:
+            steps = module_n
+        else:
+            counts = [per_op_n[k] for k, _ in per_op.most_common(20)]
+            steps = collections.Counter(counts).most_common(1)[0][0]
     total_us = sum(per_op.values())
-    print(f"# steps inferred: {steps}; total device-op time "
-          f"{total_us / 1e3:.2f} ms -> {total_us / steps / 1e3:.3f} ms/step")
+    if module_n:
+        print(f"# module (outer jit): {module_us / module_n / 1e3:.3f} "
+              f"ms/step over {module_n} steps")
+    print(f"# per-op sum {total_us / 1e3:.2f} ms -> "
+          f"{total_us / steps / 1e3:.3f} ms/step "
+          f"(shares below are of the per-op sum)")
     print(f"{'op':48s} {'ms/step':>9s} {'share':>7s} {'n':>5s}")
     for name, us in per_op.most_common(args.top):
         print(
