@@ -309,7 +309,6 @@ def main(args) -> None:
                 "it (CPU device_put aliasing disables the ring there)"
             ),
         }
-        write_partial()
     # Stays partial if the alarm skipped anything OR the headline errored:
     # tunnel_watch.sh promotes only `"partial": false` runs to
     # BENCH_live.json and stops watching, so a capture missing its
@@ -1216,13 +1215,17 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
             # by the aliasing probe; the big lever at large B).
             "stack_reuse": bool(learner._stack_reuse),
             "device_put_target": str(target),
+            # Route derived from the resolved device itself (env-var
+            # sniffing would mislabel tunnel transfers reached via the
+            # JAX_PLATFORMS=<unset> probe rung): this rig's tunnelled
+            # chip identifies as the 'axon' PJRT plugin.
             "route": (
                 "local_host_memory"
                 if target.platform == "cpu"
                 else (
                     "tunnelled_tpu_NOT_representative_of_PCIe_H2D"
-                    if "axon" in os.environ.get("JAX_PLATFORMS", "")
-                    or "axon" in os.environ.get("PYTHONPATH", "")
+                    if "axon"
+                    in getattr(target.client, "platform_version", "")
                     else "device_default"
                 )
             ),

@@ -73,6 +73,10 @@ class ExperimentConfig:
     # core; NOTES_r04.md), so it is opt-in, not inherited. Ignored (f32
     # forced, with a warning) on the sequence-parallel path.
     transformer_dtype: str = "float32"
+    # Dense-attention kernel: "auto" picks pallas-vs-einsum from the
+    # measured PALLAS_MIN_SCORE_ELEMS crossover; "pallas"/"einsum" force
+    # (the retuning affordance for non-v5e TPU generations).
+    transformer_dense_kernel: str = "auto"
     # Shard the unroll's time axis over this many devices (the 'seq' mesh
     # axis); 0 = off. Combined with dp_devices as a ('data','seq') mesh.
     sp_devices: int = 0
@@ -141,6 +145,18 @@ class ExperimentConfig:
         return max(1, self.total_env_frames // self.frames_per_step)
 
 
+# Dense-attention 'auto' crossover: use the Pallas flash kernel only when
+# the learner's score matrix reaches this many elements. Measured on ONE
+# v5e through a tunnel (r4, NOTES_r04.md): the kernel pays decisively
+# from T*S ~ 1M (1.25-1.46x at T=1024 f32, 2.5x at T=4096 bf16) but is
+# ~12% slower fwd+bwd than XLA's fused einsum at the pong_transformer
+# preset's T=21/S=149 (kernel-launch overhead over a 3k-element tile);
+# 2^18 is the middle of the measured indifference band. Other TPU
+# generations will sit elsewhere — retune by editing this constant or
+# force per-experiment via ExperimentConfig.transformer_dense_kernel.
+PALLAS_MIN_SCORE_ELEMS = 1 << 18
+
+
 def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
     """Build the policy agent for a config.
 
@@ -157,6 +173,12 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
         raise ValueError(
             f"unknown transformer_dtype {cfg.transformer_dtype!r}; "
             "expected 'float32' or 'bfloat16'"
+        )
+    if cfg.transformer_dense_kernel not in ("auto", "pallas", "einsum"):
+        raise ValueError(
+            f"unknown transformer_dense_kernel "
+            f"{cfg.transformer_dense_kernel!r}; "
+            "expected 'auto', 'pallas' or 'einsum'"
         )
     dtype = jnp.dtype(cfg.compute_dtype)
     torso_cls = {
@@ -177,23 +199,20 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
     # Dense-path attention math, resolved HERE against the actual compute
     # devices (mesh when given, default backend otherwise), mirroring the
     # learner's V-trace 'auto' resolution; the core itself refuses 'auto'.
-    # Shape-aware (r4 measurement): the flash kernel pays when the score
-    # matrix is large — decisively from T*S ~ 1M (1.25-1.46x at T=1024
-    # f32, 2.5x at T=4096 bf16) — but at the preset's T=21, S=149 it is
-    # ~12% SLOWER fwd+bwd than XLA's fused einsum (kernel-launch overhead
-    # over a 3k-element score tile), so small shapes keep the einsum even
-    # on TPU. Threshold 2^18 elements = the measured indifference band.
     from torched_impala_tpu.ops.vtrace import resolve_implementation
 
     devices = None if mesh is None else list(mesh.devices.flat)
     t_learner = cfg.unroll_length + 1
     score_elems = t_learner * (cfg.transformer_window + t_learner)
-    dense_kernel = (
-        "pallas"
-        if resolve_implementation("auto", devices) == "pallas"
-        and score_elems >= (1 << 18)
-        else "einsum"
-    )
+    if cfg.transformer_dense_kernel != "auto":
+        dense_kernel = cfg.transformer_dense_kernel
+    else:
+        dense_kernel = (
+            "pallas"
+            if resolve_implementation("auto", devices) == "pallas"
+            and score_elems >= PALLAS_MIN_SCORE_ELEMS
+            else "einsum"
+        )
     transformer = (
         ("d_model", cfg.transformer_d_model),
         ("num_layers", cfg.transformer_layers),

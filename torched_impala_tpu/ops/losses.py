@@ -38,8 +38,9 @@ class ImpalaLossConfig:
     # 'sum' matches the reference (losses summed over [T, B]); 'mean' divides
     # by the number of valid steps, decoupling lr from unroll/batch size.
     reduction: str = "sum"
-    # 'auto' = fused Pallas kernel on TPU (measured 1.3-2.8x faster than the
-    # scan on a v5e, bench.py `vtrace_pallas_vs_scan`), lax.scan elsewhere.
+    # 'auto' = fused Pallas kernel on TPU, lax.scan elsewhere. A perf
+    # NON-LEVER either way: both sit at the dispatch floor (~0.2% of a
+    # train step) on a real v5e — see ops/vtrace.py:vtrace.
     vtrace_implementation: str = "auto"
 
 

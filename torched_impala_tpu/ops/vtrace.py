@@ -25,11 +25,16 @@ BASELINE.json:5 — the reference mount was empty, see SURVEY.md §0).
 
 from __future__ import annotations
 
+import logging
 from typing import NamedTuple
 
 import chex
 import jax
 import jax.numpy as jnp
+
+# One warning per process when 'auto' falls back because the default
+# backend failed to initialize (see resolve_implementation).
+_RESOLVE_FALLBACK_LOGGED = False
 
 
 class VTraceOutput(NamedTuple):
@@ -59,14 +64,25 @@ def resolve_implementation(implementation: str, devices=None) -> str:
     """
     if implementation != "auto":
         return implementation
-    try:
-        if devices is None:
+    if devices is None:
+        # Backend init is the ONE failure worth absorbing (a wedged TPU
+        # tunnel raises here; the scan is always safe) — logged once per
+        # process so a silent downgrade is traceable. Anything else
+        # (e.g. a bogus `devices` argument) propagates: a blanket
+        # swallow hid real caller bugs behind a quiet 'scan' (VERDICT r4
+        # weak #6).
+        try:
             devices = jax.devices()
-        return (
-            "pallas" if next(iter(devices)).platform == "tpu" else "scan"
-        )
-    except Exception:
-        return "scan"
+        except Exception as e:
+            global _RESOLVE_FALLBACK_LOGGED
+            if not _RESOLVE_FALLBACK_LOGGED:
+                _RESOLVE_FALLBACK_LOGGED = True
+                logging.getLogger(__name__).warning(
+                    "vtrace 'auto': default backend unavailable (%s: %s); "
+                    "resolving to 'scan'", type(e).__name__, e,
+                )
+            return "scan"
+    return "pallas" if next(iter(devices)).platform == "tpu" else "scan"
 
 
 def _default_backend_is_tpu() -> bool:
@@ -176,10 +192,15 @@ def vtrace(
     will actually run on (e.g. `mesh.devices.flat`); runtime.Learner and
     AnakinRunner do, so a CPU mesh built in a TPU-default process still
     gets the scan. `devices=None` falls back to the default backend's
-    devices (correct for un-meshed callers only). Measured on a real v5e
-    chip (bench.py `vtrace_pallas_vs_scan`, 2026-07-29): pallas 2.81x
-    faster at Pong shapes (T=20, B=256) and 1.27x at DMLab shapes
-    (T=100, B=32).
+    devices (correct for un-meshed callers only).
+
+    Performance: a NON-LEVER at trained shapes. The r4 steady-state 6x3
+    (T, B) grid (NOTES_r04.md "V-trace kernel-vs-scan closure") found
+    BOTH implementations at the dispatch-latency floor (~17-42 us/call,
+    ~0.2% of a train step); the earlier round-2 multi-x speedup readings
+    were dispatch noise around a sub-ulp op. 'auto' -> pallas on TPU is kept
+    because it wins slightly more often than it loses and never
+    catastrophically — not because it matters.
     """
     kwargs = dict(
         log_rhos=log_rhos,

@@ -261,6 +261,12 @@ class Learner:
         # Resolve the batcher's device_put target ONCE: a typo'd backend
         # name fails here, loudly, instead of per-batch inside the
         # batcher thread (surfaced only via self.error).
+        if config.data_device is not None and mesh is not None:
+            raise ValueError(
+                "LearnerConfig.data_device is a measurement/staging knob "
+                "and cannot combine with a mesh: the pjit'd step expects "
+                "mesh-sharded batches, not arrays on another backend"
+            )
         self._data_device = (
             jax.local_devices(backend=config.data_device)[0]
             if config.data_device is not None

@@ -41,7 +41,7 @@ for i in $(seq 1 60); do
     if grep -q '"backend": "tpu"' /root/repo/BENCH_watch.json 2>/dev/null; then
       if [ $rc -eq 0 ] && grep -q '"partial": false' /root/repo/BENCH_watch.json; then
         cp /root/repo/BENCH_watch.json /root/repo/BENCH_live.json
-        git add BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels 2>/dev/null
+        git add -f BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels 2>/dev/null
         git commit -m "bench: fresh full-section real-chip capture after tunnel recovery" -- BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels >> /tmp/tunnel_watch.log 2>&1
         echo "$(date +%H:%M:%S) committed fresh full TPU bench" >> /tmp/tunnel_watch.log
         exit 0
