@@ -82,7 +82,13 @@ def resolve_implementation(implementation: str, devices=None) -> str:
                     "resolving to 'scan'", type(e).__name__, e,
                 )
             return "scan"
-    return "pallas" if next(iter(devices)).platform == "tpu" else "scan"
+    first = next(iter(devices), None)
+    if first is None:
+        # An explicit empty iterable is a caller bug; a bare
+        # StopIteration here could be swallowed by iterator-protocol
+        # frames in the caller's caller.
+        raise ValueError("resolve_implementation: `devices` is empty")
+    return "pallas" if first.platform == "tpu" else "scan"
 
 
 def _default_backend_is_tpu() -> bool:
