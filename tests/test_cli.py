@@ -72,6 +72,19 @@ class TestRegistry:
 
 
 class TestCLI:
+    def test_doctor_passes_on_this_host(self, capsys):
+        """--doctor validates the env stack: on this gymnasium-only host
+        the required deps and cartpole must pass, the emulator families
+        must report missing (NOT failed), and the train probe must run
+        two real learner steps."""
+        rc = cli_main(["--doctor", "--config", "cartpole"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "doctor: PASS" in out
+        assert "env cartpole   [ok]" in out
+        assert "[missing]" in out and "[FAIL]" not in out
+        assert "train cartpole [ok]" in out
+
     def test_cartpole_train_smoke(self, tmp_path):
         rc = cli_main([
             "--config", "cartpole",

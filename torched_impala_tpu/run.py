@@ -25,7 +25,13 @@ import numpy as np
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", required=True, help="preset name")
+    p.add_argument("--config", default=None, help="preset name")
+    p.add_argument("--doctor", action="store_true",
+                   help="validate this host's env/emulator stack and exit: "
+                        "dependency inventory, accelerator jit, per-family "
+                        "env contracts (missing emulators reported, not "
+                        "failed), and — with --config — a 2-step real-env "
+                        "train probe (<1 min total)")
     p.add_argument("--mode", choices=("train", "eval"), default="train")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
@@ -197,6 +203,12 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.doctor:
+        from torched_impala_tpu.doctor import run_doctor
+
+        return run_doctor(args.config)
+    if args.config is None:
+        raise SystemExit("--config is required (unless --doctor)")
     if args.coordinator or args.num_hosts or args.host_id is not None:
         from torched_impala_tpu.parallel import multihost
 
