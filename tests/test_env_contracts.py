@@ -101,16 +101,12 @@ def test_factory_kwargs_bind_to_installed_gymnasium():
     """Every kwarg `wrap_atari` passes must exist in the installed
     gymnasium 1.2.2 wrapper signatures (catches API drift at upgrade
     time, not on the first ALE host)."""
+    from torched_impala_tpu.envs.factory import ATARI_PREPROCESSING_KWARGS
+
     sig = inspect.signature(gymnasium.wrappers.AtariPreprocessing.__init__)
-    sig.bind(
-        None,  # self
-        None,  # env
-        noop_max=30,
-        frame_skip=4,
-        screen_size=84,
-        grayscale_obs=True,
-        scale_obs=False,
-    )
+    # The SAME dict wrap_atari passes — literals here would let the
+    # factory and the pin drift apart.
+    sig.bind(None, None, **ATARI_PREPROCESSING_KWARGS)
     inspect.signature(
         gymnasium.wrappers.FrameStackObservation.__init__
     ).bind(None, None, 4)

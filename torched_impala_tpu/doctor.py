@@ -28,22 +28,42 @@ import time
 import traceback
 
 
-def _version(mod_name: str) -> str | None:
+def _probe_module(mod_name: str) -> tuple[str, str]:
+    """("ok", version) | ("absent", "") | ("broken", error).
+
+    Native-lib packages (ale_py, procgen, deepmind_lab) commonly fail
+    import with OSError/RuntimeError (missing .so) rather than
+    ImportError — a broken install must diagnose as broken, not crash
+    the doctor or masquerade as cleanly absent."""
     try:
         mod = importlib.import_module(mod_name)
     except ImportError:
-        return None
-    return getattr(mod, "__version__", "present")
+        return "absent", ""
+    except Exception as e:
+        return "broken", f"{type(e).__name__}: {e}"
+    return "ok", getattr(mod, "__version__", "present")
 
 
-# Which optional module gates each env family: an ImportError from a
-# family whose module IS importable is a real failure, not "missing".
-_FAMILY_MODULE = {
-    "cartpole": "gymnasium",
-    "atari": "ale_py",
-    "procgen": "procgen",
-    "dmlab": "deepmind_lab",
+# Which optional modules gate each env family: an ImportError from a
+# family whose modules ALL import fine is a real failure, not "missing".
+# cv2 rides with atari (gymnasium's AtariPreprocessing hard-depends on
+# it) — it is NOT globally required, so a procgen/dmlab-only host
+# without opencv still gets doctor: PASS.
+_FAMILY_MODULES = {
+    "cartpole": ("gymnasium",),
+    "atari": ("ale_py", "cv2"),
+    "procgen": ("procgen",),
+    "dmlab": ("deepmind_lab",),
 }
+
+
+def _family_gate(name: str) -> tuple[str, str]:
+    """("ok"|"absent"|"broken", detail) across the family's modules."""
+    for mod in _FAMILY_MODULES[name]:
+        status, detail = _probe_module(mod)
+        if status != "ok":
+            return status, f"{mod} {detail}".strip()
+    return "ok", ""
 
 
 def _check_env_contract(name: str) -> tuple[str, str]:
@@ -56,16 +76,17 @@ def _check_env_contract(name: str) -> tuple[str, str]:
     from torched_impala_tpu.envs import factory as F
 
     t0 = time.perf_counter()
+    gate, gate_detail = _family_gate(name)
+    if gate == "broken":
+        return "FAIL", f"broken install: {gate_detail}"
     try:
         env, num_actions, example = F.FACTORIES[name]()
-    except ImportError as e:
-        if _version(_FAMILY_MODULE[name]) is None:
+    except Exception as e:
+        if gate == "absent" and isinstance(e, ImportError):
             return "missing", str(e).split(". ")[0]
-        # The gating module imports fine, so this ImportError is a bug
-        # (broken install, or a typo'd lazy import in OUR code) — the
-        # exact launch-day surprise the doctor exists to catch.
-        return "FAIL", f"construction raised:\n{traceback.format_exc()}"
-    except Exception:
+        # Every gating module imports fine (or the error isn't the
+        # missing-emulator ImportError), so this is a bug — the exact
+        # launch-day surprise the doctor exists to catch.
         return "FAIL", f"construction raised:\n{traceback.format_exc()}"
     try:
         rng = np.random.default_rng(0)
@@ -117,9 +138,11 @@ def _train_probe(config_name: str) -> tuple[str, str]:
     from torched_impala_tpu.utils.loggers import NullLogger
 
     cfg = configs.REGISTRY[config_name]
-    family_mod = _FAMILY_MODULE.get(cfg.env_family)
-    if family_mod is not None and _version(family_mod) is None:
-        return "missing", f"{cfg.env_family} needs {family_mod}"
+    gate, gate_detail = _family_gate(cfg.env_family)
+    if gate == "absent":
+        return "missing", f"{cfg.env_family} needs {gate_detail or '?'}"
+    if gate == "broken":
+        return "FAIL", f"broken install: {gate_detail}"
     try:
         # Doctor-sized: the smallest batch the runtime accepts, so the
         # probe is dominated by one compile, not data collection.
@@ -162,15 +185,20 @@ def run_doctor(config_name: str | None = None) -> int:
         ("flax", True),
         ("optax", True),
         ("gymnasium", True),
-        ("cv2", True),  # AtariPreprocessing hard-depends on it
+        ("cv2", False),  # needed by the atari family only
         ("ale_py", False),
         ("procgen", False),
         ("deepmind_lab", False),
     ):
-        v = _version(mod)
-        tag = "ok" if v else ("MISSING (required)" if required else "missing")
-        required_ok &= bool(v) or not required
-        print(f"  dep {mod:14s} {v or '-':12s} [{tag}]")
+        status, detail = _probe_module(mod)
+        if status == "ok":
+            tag = "ok"
+        elif status == "broken":
+            tag = f"BROKEN: {detail}"
+        else:
+            tag = "MISSING (required)" if required else "missing"
+        required_ok &= status == "ok" or not required
+        print(f"  dep {mod:14s} {detail if status == 'ok' else '-':12s} [{tag}]")
     if not required_ok:
         print("doctor: FAIL (required dependency missing)")
         return 1

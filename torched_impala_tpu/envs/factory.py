@@ -101,6 +101,19 @@ def make_atari(
     return env, n, np.zeros((84, 84, frame_stack), np.uint8)
 
 
+# The exact preprocessing options passed to gymnasium's
+# AtariPreprocessing — one definition shared by `wrap_atari` and the
+# signature-pin contract test (tests/test_env_contracts.py), so the
+# pinned kwargs can never drift from the ones actually used.
+ATARI_PREPROCESSING_KWARGS = dict(
+    noop_max=30,
+    frame_skip=4,
+    screen_size=84,
+    grayscale_obs=True,
+    scale_obs=False,
+)
+
+
 def wrap_atari(
     env,
     *,
@@ -120,12 +133,7 @@ def wrap_atari(
     import gymnasium
 
     env = gymnasium.wrappers.AtariPreprocessing(
-        env,
-        noop_max=30,
-        frame_skip=4,
-        screen_size=84,
-        grayscale_obs=True,
-        scale_obs=False,
+        env, **ATARI_PREPROCESSING_KWARGS
     )
     env = gymnasium.wrappers.FrameStackObservation(env, frame_stack)
     if reward_clip:
