@@ -431,14 +431,31 @@ class _LearnerFixture:
                     lambda x: jnp.stack([x] * fused_k), self._arrays
                 )
             )
-        self._state = (
-            learner.params,
-            learner.opt_state,
-            learner._popart_state,
-        )
-        self.step_fn = learner._train_step.lower(
-            *self._state, *self._arrays
-        ).compile()
+        if learner._auto_jit is not None:
+            # Measure the PRODUCT path: AUTO input layouts, batch data
+            # pre-laid into the step's preferred formats (what the real
+            # batcher ships since LearnerConfig.auto_layouts).
+            learner._ensure_auto_compiled(self._arrays)
+            from torched_impala_tpu.runtime.learner import _put_format
+
+            self._arrays = jax.tree.map(
+                _put_format, self._arrays, learner._batch_formats
+            )
+            self._state = (
+                learner.params,
+                learner.opt_state,
+                learner._popart_state,
+            )
+            self.step_fn = learner._auto_compiled
+        else:
+            self._state = (
+                learner.params,
+                learner.opt_state,
+                learner._popart_state,
+            )
+            self.step_fn = learner._train_step.lower(
+                *self._state, *self._arrays
+            ).compile()
         # Warmup (first real execution).
         self.logs = self.run_steps(1)
 

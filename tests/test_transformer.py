@@ -498,11 +498,16 @@ class TestBf16Core:
         # Every parameter (incl. all block Dense kernels) gets signal.
         assert sum(1 for n in norms if n > 0) == len(norms)
 
+    @pytest.mark.slow
     def test_bf16_pallas_kernel_engages_and_matches_einsum(self, monkeypatch):
         """bf16 + dense_kernel='pallas' — the exact pairing the dtype
         lever targets (bf16 operands through the flash kernels): the
         kernel must ENGAGE (no silent fallback) and match the bf16
-        einsum core within bf16 rounding."""
+        einsum core within bf16 rounding.
+
+        slow: 55 s of interpret-mode Pallas on CPU (r5 durations); the
+        kernel parity suite (test_attention_pallas) stays in the quick
+        gate and the real-TPU engagement is bench-verified every round."""
         from torched_impala_tpu.ops import attention_pallas
 
         calls = []

@@ -58,8 +58,12 @@ _FAMILY_MODULES = {
 
 
 def _family_gate(name: str) -> tuple[str, str]:
-    """("ok"|"absent"|"broken", detail) across the family's modules."""
-    for mod in _FAMILY_MODULES[name]:
+    """("ok"|"absent"|"broken", detail) across the family's modules.
+
+    Families with no gating modules (the pure-JAX Anakin envs —
+    jax_cartpole/jax_catch/jax_pixels — need nothing beyond jax) are
+    trivially ok."""
+    for mod in _FAMILY_MODULES.get(name, ()):
         status, detail = _probe_module(mod)
         if status != "ok":
             return status, f"{mod} {detail}".strip()
