@@ -15,9 +15,16 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_max_isa" not in _flags:
+    # Pin the CPU codegen ISA: XLA's per-process feature detection is not
+    # stable on this box (AMX flags appear in some processes only), and
+    # the persistent compile cache would otherwise load AOT executables
+    # whose compile-time features the loading process doesn't report —
+    # the loader warns about possible SIGILL. A fixed baseline makes
+    # cache entries portable across processes.
+    _flags = (_flags + " --xla_cpu_max_isa=AVX512").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 import jax
 
