@@ -155,6 +155,7 @@ def _run_core(dense_kernel, engaged_counter=None):
     return out1, out2, state2, g
 
 
+@pytest.mark.slow  # 30 s interpret-mode: op-level kernel parity stays quick-gated
 def test_core_pallas_matches_einsum_including_grads(monkeypatch):
     calls = []
     real = attention_pallas.windowed_attention

@@ -56,6 +56,7 @@ class TestEquivalence:
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
         )
 
+    @pytest.mark.slow  # 23 s: numeric-edge stability; full gate covers
     def test_extreme_logits_stay_stable(self):
         # Online softmax must survive large-magnitude logits (the reason
         # for the running max).

@@ -185,17 +185,17 @@ class TestEndToEnd:
             agent=agent,
             # crash_after must make restarts STRUCTURALLY required, not
             # timing-dependent: CrashingEnv raises ON its Nth step, so at
-            # 10 each env completes 9 steps = 1 full T=5 unroll, and the
-            # fleet produces only 4 of the 20 trajectories the 5 learner
-            # steps consume before every actor needs restarting (~8
-            # restarts total against the 10-per-actor budget). The old
+            # 14 each env completes 13 steps = 2 full T=5 unrolls, the
+            # initial fleet caps at 8 of the 20 trajectories the 5
+            # learner steps consume, and ~3 restarts (~1.5 s total
+            # backoff) are forced regardless of learner speed. The old
             # value 30 allowed 5 unrolls x 4 envs = exactly 20 — a fast
             # learner (r5 compile cache warm) finished with 0 restarts.
             env_factory=lambda seed: CrashingEnv(
                 FakeDiscreteEnv(
                     obs_shape=(4,), num_actions=3, episode_len=7, seed=seed
                 ),
-                crash_after=10,
+                crash_after=14,
             ),
             example_obs=np.zeros((4,), np.float32),
             num_actors=2,
