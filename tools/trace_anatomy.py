@@ -90,12 +90,36 @@ def anatomy(path: str):
     return per_op, per_op_n, module_us, module_n
 
 
+def hlo_attribution(hlo_path: str) -> dict:
+    """op name -> (result type+shape, source op_name metadata) from an
+    HLO text dump (`compiled.as_text()`): automates the by-hand greps
+    that mapped trace ops to model code in rounds 4-5."""
+    import re
+
+    attr = {}
+    text = open(hlo_path).read()
+    for m in re.finditer(
+        r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+) = (?P<ty>\S+)"
+        r"(?:.*?op_name=\"(?P<op>[^\"]+)\")?",
+        text,
+        re.M,
+    ):
+        ty = m.group("ty")
+        # Trim layout/tiling annotations out of the type for brevity.
+        ty = ty.split("{")[0]
+        attr[m.group("name")] = (ty, m.group("op") or "")
+    return attr
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("trace_dir")
     ap.add_argument("--steps", type=int, default=None,
                     help="steps in the capture (default: modal op count)")
     ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--hlo", default=None,
+                    help="HLO text dump (compiled.as_text()) to attribute "
+                         "each op to its result shape + source op_name")
     args = ap.parse_args(argv)
 
     path = newest_capture(args.trace_dir)
@@ -121,12 +145,19 @@ def main(argv=None):
     print(f"# per-op sum {total_us / 1e3:.2f} ms -> "
           f"{total_us / steps / 1e3:.3f} ms/step "
           f"(shares below are of the per-op sum)")
-    print(f"{'op':48s} {'ms/step':>9s} {'share':>7s} {'n':>5s}")
+    attr = hlo_attribution(args.hlo) if args.hlo else {}
+    print(f"{'op':36s} {'ms/step':>9s} {'share':>7s} {'n':>5s}")
     for name, us in per_op.most_common(args.top):
-        print(
-            f"{name[:48]:48s} {us / steps / 1e3:9.3f} "
+        line = (
+            f"{name[:36]:36s} {us / steps / 1e3:9.3f} "
             f"{us / total_us:6.1%} {per_op_n[name]:5d}"
         )
+        if attr:
+            ty, op = attr.get(name, ("?", ""))
+            # Keep the informative tail of the op_name (module path).
+            op_short = "/".join(op.split("/")[-3:]) if op else ""
+            line += f"  {ty:28s} {op_short}"
+        print(line)
     return 0
 
 

@@ -277,18 +277,58 @@ class AnakinRunner:
 
         carry, ys = jax.lax.scan(body, carry, None, length=T)
         obs_t, first_t, actions, behaviour_logits, rewards, cont, done_rets = ys
-        # Bootstrap entries: the state the rollout stopped in.
-        obs_full = jnp.concatenate([obs_t, observe(carry[1])[None]], axis=0)
-        first_full = jnp.concatenate([first_t, carry[2][None]], axis=0)
+        use_step_bootstrap = agent.net._core_kind() != "transformer"
+        if use_step_bootstrap:
+            # Bootstrap value from ONE step-mode forward on the state
+            # the rollout stopped in — instead of concatenating the
+            # bootstrap row onto the rollout and unrolling over [T+1]:
+            # at pixel shapes that concat materialized two extra passes
+            # over the whole rollout (r5 trace: copy.12 +
+            # pad_add_fusion.3 = 1.48 ms of a 12.8 ms step, 234 MB each
+            # at E=128/T=64). No gradient flows through the bootstrap
+            # (impala_loss stop-gradients it; the baseline loss
+            # regresses values[:T] only) and for ff/LSTM cores
+            # step-mode from the rollout's threaded post-scan state
+            # (carry[3], computed under these same params — on-policy
+            # within the program) reproduces the [T+1] unroll's last
+            # value exactly. NOT true for the transformer core: its
+            # step-mode KV cache evicts beyond `window`, while the
+            # dense unroll attends to the full cache+T context — that
+            # core keeps the concat path below.
+            boot_out, _ = agent.net.apply(
+                params, observe(carry[1]), carry[2], carry[3],
+                unroll=False,
+            )
+            bootstrap_value = jax.lax.stop_gradient(
+                jnp.squeeze(boot_out.values, -1)  # [E]
+            )
+        else:
+            obs_full = jnp.concatenate(
+                [obs_t, observe(carry[1])[None]], axis=0
+            )
+            first_full = jnp.concatenate(
+                [first_t, carry[2][None]], axis=0
+            )
 
         def loss_fn(p):
-            net_out, _ = agent.unroll(p, obs_full, first_full, start_state)
-            values = jnp.squeeze(net_out.values, -1)  # [T+1, E]
+            if use_step_bootstrap:
+                net_out, _ = agent.unroll(p, obs_t, first_t, start_state)
+                values = jnp.squeeze(net_out.values, -1)  # [T, E]
+                boot = bootstrap_value
+            else:
+                net_out, _ = agent.unroll(
+                    p, obs_full, first_full, start_state
+                )
+                values_full = jnp.squeeze(net_out.values, -1)  # [T+1, E]
+                values, boot = values_full[:-1], values_full[-1]
+                net_out = net_out._replace(
+                    policy_logits=net_out.policy_logits[:-1]
+                )
             out = impala_loss(
-                target_logits=net_out.policy_logits[:-1],
+                target_logits=net_out.policy_logits,
                 behaviour_logits=behaviour_logits,
-                values=values[:-1],
-                bootstrap_value=values[-1],
+                values=values,
+                bootstrap_value=boot,
                 actions=actions,
                 rewards=rewards,
                 discounts=cfg.discount * cont,
