@@ -431,28 +431,59 @@ class _LearnerFixture:
                     lambda x: jnp.stack([x] * fused_k), self._arrays
                 )
             )
+        auto_ok = False
+        state = (
+            learner.params,
+            learner.opt_state,
+            learner._popart_state,
+        )
         if learner._auto_jit is not None:
             # Measure the PRODUCT path: AUTO input layouts, batch data
             # pre-laid into the step's preferred formats (what the real
-            # batcher ships since LearnerConfig.auto_layouts).
-            learner._ensure_auto_compiled(self._arrays)
-            from torched_impala_tpu.runtime.learner import _put_format
+            # batcher ships since LearnerConfig.auto_layouts). Probed
+            # with one call: on some shapes the backend's device_put
+            # returns a layout that disagrees with the compiled format
+            # (observed at B=1024 on the tunnelled v5e) — fall back to
+            # the plain lowering then, like the product learner does.
+            # The probe call DONATES the state buffers; keep a host
+            # snapshot so the fallback path can rebuild them if the
+            # call fails after consuming its inputs.
+            state_host = jax.tree.map(lambda x: np.asarray(x), state)
+            try:
+                learner._ensure_auto_compiled(self._arrays)
+                from torched_impala_tpu.runtime.learner import _put_format
 
-            self._arrays = jax.tree.map(
-                _put_format, self._arrays, learner._batch_formats
-            )
-            self._state = (
-                learner.params,
-                learner.opt_state,
-                learner._popart_state,
-            )
-            self.step_fn = learner._auto_compiled
-        else:
-            self._state = (
-                learner.params,
-                learner.opt_state,
-                learner._popart_state,
-            )
+                auto_arrays = jax.tree.map(
+                    _put_format, self._arrays, learner._batch_formats
+                )
+                # Re-capture AFTER ensure: it re-lays the learner's
+                # state into the compiled formats; probing with the
+                # stale pre-relayout references would fail the layout
+                # check spuriously (review catch, r5).
+                state = (
+                    learner.params,
+                    learner.opt_state,
+                    learner._popart_state,
+                )
+                probe = learner._auto_compiled(*state, *auto_arrays)
+                jax.block_until_ready(jax.tree.leaves(probe)[0])
+                self._arrays = auto_arrays
+                self._state = tuple(probe[:3])
+                self.step_fn = learner._auto_compiled
+                auto_ok = True
+            except ValueError as e:
+                if "layouts that disagree" not in str(e):
+                    raise
+                log(
+                    "bench: AUTO-layout probe disagreed at "
+                    f"T={T} B={B}; using the plain step"
+                )
+        if not auto_ok:
+            if learner._auto_jit is not None:
+                # The failed probe may have consumed its donated
+                # inputs; rebuild from the host snapshot.
+                state = jax.device_put(state_host)
+            self._state = state
             self.step_fn = learner._train_step.lower(
                 *self._state, *self._arrays
             ).compile()

@@ -765,7 +765,7 @@ class Learner:
         avals (batcher thread); re-lay the live state into the compiled
         formats. Thread-safe; runs once."""
         with self._auto_lock:
-            if self._auto_compiled is not None:
+            if self._auto_compiled is not None or self._auto_jit is None:
                 return
             def aval(x):
                 x = np.asanyarray(x) if not hasattr(x, "dtype") else x
@@ -997,6 +997,9 @@ class Learner:
             if self._data_device is not None:
                 on_device = jax.device_put(arrays, self._data_device)
             elif self._mesh is None:
+                # Locals, not repeated attribute reads: step_once's
+                # layout-mismatch fallback nulls these from the main
+                # thread and must not race this thread mid-branch.
                 if self._auto_jit is not None:
                     # First batch: AOT-compile with XLA-chosen layouts
                     # and learn the batch input formats; later batches
@@ -1004,9 +1007,11 @@ class Learner:
                     # layouts (no in-step relayout).
                     if self._batch_formats is None:
                         self._ensure_auto_compiled(arrays)
-                    on_device = jax.tree.map(
-                        _put_format, arrays, self._batch_formats
-                    )
+                    fmts = self._batch_formats
+                else:
+                    fmts = None
+                if fmts is not None:
+                    on_device = jax.tree.map(_put_format, arrays, fmts)
                 else:
                     on_device = jax.device_put(arrays)
             else:
@@ -1076,9 +1081,63 @@ class Learner:
             if self._auto_compiled is not None
             else self._train_step
         )
-        self._params, self._opt_state, self._popart_state, logs = step(
-            self._params, self._opt_state, self._popart_state, *arrays
-        )
+        try:
+            self._params, self._opt_state, self._popart_state, logs = step(
+                self._params, self._opt_state, self._popart_state, *arrays
+            )
+        except ValueError as e:
+            if (
+                self._auto_compiled is None
+                or "layouts that disagree" not in str(e)
+            ):
+                raise
+            # device_put into the compiled Format came back with a
+            # layout the AOT executable refuses (shape-dependent; the
+            # plain jit relayouts inputs as needed). Fall back
+            # permanently rather than crash training.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "auto_layouts: batch layout disagreed with the compiled "
+                "formats (%s); falling back to the standard train step",
+                str(e).splitlines()[0],
+            )
+            # _auto_jit=None stops the batcher's formats-put AND the
+            # recompile path (in-flight formats-laid batches still run:
+            # the plain jit relayouts any input).
+            self._auto_jit = None
+            self._auto_compiled = None
+            self._batch_formats = None
+            # The failed call's donate_argnums may or may not have
+            # consumed the state buffers depending on where validation
+            # raised. Probe liveness before retrying: a retry on
+            # deleted buffers would crash with a misleading "Array has
+            # been deleted" — fail with an actionable message instead.
+            def _alive(tree):
+                return all(
+                    not getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree.leaves(tree)
+                )
+
+            if not (
+                _alive(self._params)
+                and _alive(self._opt_state)
+                and _alive(self._popart_state)
+            ):
+                raise RuntimeError(
+                    "auto_layouts fallback: the failed step consumed "
+                    "its donated state buffers; restart from the last "
+                    "checkpoint (this path is only reachable if the "
+                    "backend validates layouts after donation)"
+                ) from e
+            self._params, self._opt_state, self._popart_state, logs = (
+                self._train_step(
+                    self._params,
+                    self._opt_state,
+                    self._popart_state,
+                    *arrays,
+                )
+            )
         T = self._config.unroll_length
         K = self._config.steps_per_dispatch
         self.num_frames += T * self._config.batch_size * K
