@@ -963,7 +963,10 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
 # on the current low-dispatch-latency tunnel, and with first-window
 # noise removed the program is compute-bound by E=128 — E128_T20 led at
 # 440k with E128_T40 next (426k); larger E buys nothing.
-ANAKIN_PIXELS_LOCKED = ((128, 20, 1), (128, 40, 1))
+# Locked fast-mode configs, retuned each time the step changes: the r5
+# bootstrap-concat removal shortened the update enough that N=8
+# dispatch fusion pays again (final r5 capture best: E128_T10_N8).
+ANAKIN_PIXELS_LOCKED = ((128, 20, 1), (128, 10, 8))
 
 
 def run_bench_anakin_pixels(jax, fast: bool = False) -> dict:
