@@ -5,6 +5,11 @@ The pool's contract: same trajectory semantics as in-process envs, plus
 worker-crash repair. The equivalence test pins that contract exactly — a
 pooled VectorActor must emit bit-identical trajectories to a thread-mode
 VectorActor over the same deterministic envs.
+
+The async (ready-set) mode tests pin the ISSUE 1 contract: partial-wave
+scheduling through the shm action/reward lanes, per-env trajectory
+time-contiguity, worker restart mid-wave, and env-stream parity with the
+lockstep path on deterministic envs.
 """
 
 import numpy as np
@@ -110,6 +115,260 @@ class TestProcessEnvPool:
                     pool.step_all(np.zeros(1))
         finally:
             pool.close()
+
+
+class TestAsyncPool:
+    def test_submit_wait_cycle_via_shm_lanes(self):
+        """The async protocol round-trip: actions go out through the shm
+        action lane (payload-free step token), rewards/dones come back
+        through their lanes with the ('stepped', events) ack."""
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, mode="async",
+            ready_fraction=0.5,
+        )
+        try:
+            pool.reset_all()
+            for w in range(2):
+                assert pool.submit(w, np.zeros((2,), np.int32))
+            got = {}
+            while len(got) < 2:
+                for w, rew, dn, events, ok in pool.wait_any():
+                    got[w] = (rew, dn, ok)
+            for w, (rew, dn, ok) in got.items():
+                assert ok
+                np.testing.assert_array_equal(rew, 1.0)
+                assert not dn.any()
+                # ScriptedEnv obs[0] counts steps-in-episode.
+                np.testing.assert_array_equal(pool.read_obs(w)[:, 0], 1)
+        finally:
+            pool.close()
+
+    def test_partial_wave_leaves_stragglers_untouched(self):
+        """Stepping only worker 0 must advance ONLY worker 0's envs —
+        the straggler (worker 1) keeps its rows until its own wave."""
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, mode="async",
+            ready_fraction=0.5,
+        )
+        try:
+            pool.reset_all()
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            results = pool.wait_any()
+            assert [r[0] for r in results] == [0]
+            np.testing.assert_array_equal(pool.read_obs(0)[:, 0], 1)
+            np.testing.assert_array_equal(pool.read_obs(1)[:, 0], 0)
+        finally:
+            pool.close()
+
+    def test_events_use_global_env_indices(self):
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, mode="async",
+        )
+        try:
+            pool.reset_all()
+            all_events = []
+            for _ in range(5):  # ScriptedEnv episodes last 5 steps
+                for w in range(2):
+                    assert pool.submit(w, np.zeros((2,), np.int32))
+                seen = 0
+                while seen < 2:
+                    for _, _, _, events, _ in pool.wait_any():
+                        seen += 1
+                        all_events += events
+            assert sorted(e[0] for e in all_events) == [0, 1, 2, 3]
+            assert all(ret == 5.0 and ln == 5 for _, ret, ln in all_events)
+        finally:
+            pool.close()
+
+    def test_dead_worker_repaired_with_crash_boundary(self):
+        """A worker SIGKILLed while a step is in flight must come back as
+        an ok=False result (reward 0, done True, fresh reset obs) after an
+        in-line restart — not crash the inference actor. The step delay
+        keeps the worker mid-step when the kill lands (otherwise a fast
+        fake env can ack before the signal — the race this test is NOT
+        about)."""
+        from torched_impala_tpu.envs.fake import StragglerFactory
+
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, mode="async",
+            factory=StragglerFactory(scripted_factory, base_delay_s=0.3),
+        )
+        try:
+            pool.reset_all()
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            pool._procs[0].kill()
+            pool._procs[0].join(timeout=10)
+            results = pool.wait_any()
+            assert [r[0] for r in results] == [0]
+            _, rew, dn, events, ok = results[0]
+            assert not ok and pool.restarts == 1
+            np.testing.assert_array_equal(rew, 0.0)
+            assert dn.all() and events == []
+            # Fresh reset obs are already in shm; stepping resumes.
+            np.testing.assert_array_equal(pool.read_obs(0)[:, 0], 0)
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            (r,) = pool.wait_any()
+            assert r[0] == 0 and r[4]
+        finally:
+            pool.close()
+
+    def test_invalid_mode_and_fraction_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            make_pool(mode="eager")
+        with pytest.raises(ValueError, match="ready_fraction"):
+            make_pool(mode="async", ready_fraction=0.0)
+
+    def test_reset_all_drains_in_flight_steps(self):
+        """A respawned inference actor can re-attach while its
+        predecessor's step commands are outstanding: reset_all must drain
+        those acks instead of racing them with the reset reply."""
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, mode="async",
+        )
+        try:
+            pool.reset_all()
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            obs = pool.reset_all()  # no wait_any: ack still in flight
+            np.testing.assert_array_equal(obs[:, 0], 0)
+            # Stepping works normally afterwards.
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            (r,) = pool.wait_any()
+            assert r[0] == 0 and r[4]
+        finally:
+            pool.close()
+
+
+class TestAsyncVectorActor:
+    def _collect(self, envs_arg, unrolls=3, unroll_length=7):
+        import jax
+
+        agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+        params = agent.init_params(
+            jax.random.key(0), np.zeros((4,), np.float32)
+        )
+        store = ParamStore()
+        store.publish(0, params)
+        out = []
+        actor = VectorActor(
+            actor_id=0,
+            envs=envs_arg,
+            agent=agent,
+            param_store=store,
+            enqueue=out.append,
+            unroll_length=unroll_length,
+            seed=123,
+        )
+        for _ in range(unrolls):
+            actor.unroll_and_push()
+        return out
+
+    def test_async_matches_lockstep_env_stream(self):
+        """Return parity (ISSUE 1 acceptance): ScriptedEnv dynamics are
+        action-independent and deterministic, so async ready-set waves
+        must reproduce the lockstep path's obs/reward/first/cont streams
+        exactly — same episode boundaries, same staleness semantics —
+        even though wave scheduling (and thus policy-key consumption)
+        differs."""
+        lockstep = make_pool(num_workers=2, envs_per_worker=3)
+        try:
+            base = self._collect(lockstep)
+        finally:
+            lockstep.close()
+        async_pool = make_pool(
+            num_workers=2, envs_per_worker=3, mode="async",
+            ready_fraction=0.5,
+        )
+        try:
+            waves = self._collect(async_pool)
+        finally:
+            async_pool.close()
+        assert len(base) == len(waves) == 3 * 6  # 3 unrolls x 6 envs
+        for l, a in zip(base, waves):
+            np.testing.assert_array_equal(l.obs, a.obs)
+            np.testing.assert_array_equal(l.rewards, a.rewards)
+            np.testing.assert_array_equal(l.first, a.first)
+            np.testing.assert_array_equal(l.cont, a.cont)
+            assert l.actions.shape == a.actions.shape
+            assert l.behaviour_logits.shape == a.behaviour_logits.shape
+            assert l.task == a.task
+
+    def test_async_trajectories_time_contiguous(self):
+        """Each env row must advance by exactly one step per slot even
+        when waves serve workers out of order: ScriptedEnv obs encode
+        (step_in_episode, episode_idx), so contiguity is checkable
+        directly from the emitted trajectories."""
+        pool = make_pool(
+            num_workers=4, envs_per_worker=1, mode="async",
+            ready_fraction=0.25,  # waves of one worker — maximal reorder
+        )
+        try:
+            trajs = self._collect(pool, unrolls=2, unroll_length=6)
+        finally:
+            pool.close()
+        assert len(trajs) == 8
+        for traj in trajs:
+            step_in_ep = traj.obs[:, 0]
+            episode = traj.obs[:, 1]
+            for t in range(traj.obs.shape[0] - 1):
+                if traj.first[t + 1]:  # episode boundary: fresh reset
+                    assert step_in_ep[t + 1] == 0
+                    assert episode[t + 1] == episode[t] + 1
+                else:  # within an episode: exactly one step forward
+                    assert step_in_ep[t + 1] == step_in_ep[t] + 1
+                    assert episode[t + 1] == episode[t]
+            # Staleness/first semantics match the lockstep contract.
+            np.testing.assert_array_equal(
+                traj.first[1:], traj.cont == 0.0
+            )
+
+    def test_async_worker_restart_mid_wave(self):
+        """Crashing workers under async scheduling repair through the
+        ok=False path: trajectories stay aligned (first mirrors cont) and
+        the crash rows appear as clean zero-reward episode boundaries."""
+        factory = CrashingFactory(scripted_factory, crash_after=7)
+        pool = make_pool(
+            num_workers=2, envs_per_worker=2, factory=factory,
+            max_restarts=10, mode="async", ready_fraction=0.5,
+        )
+        try:
+            trajs = self._collect(pool, unrolls=3, unroll_length=5)
+        finally:
+            pool.close()
+        assert pool.restarts >= 1
+        assert len(trajs) == 12
+        crash_rows = 0
+        for traj in trajs:
+            np.testing.assert_array_equal(
+                traj.first[1:], traj.cont == 0.0
+            )
+            assert np.isfinite(traj.rewards).all()
+            # Crash boundaries: done with zero reward (real ScriptedEnv
+            # episode ends pay reward 1 on the final step).
+            crash_rows += int(
+                np.any((traj.cont == 0.0) & (traj.rewards == 0.0))
+            )
+        assert crash_rows >= 1
+
+    def test_train_async_mode_e2e(self):
+        agent = Agent(ImpalaNet(num_actions=2, torso=MLPTorso()))
+        result = train(
+            agent=agent,
+            env_factory=discrete_factory,
+            example_obs=np.zeros((4,), np.float32),
+            num_actors=2,
+            learner_config=LearnerConfig(batch_size=2, unroll_length=4),
+            optimizer=optax.sgd(1e-3),
+            total_steps=3,
+            envs_per_actor=2,
+            actor_mode="process",
+            pool_mode="async",
+            pool_ready_fraction=0.5,
+            actor_device=None,
+            log_every=1,
+        )
+        assert result.learner.num_steps == 3
+        assert result.num_frames == 3 * 2 * 4
+        assert np.isfinite(result.final_logs.get("total_loss", np.nan))
 
 
 class TestPooledVectorActor:

@@ -109,6 +109,14 @@ class ExperimentConfig:
     num_actors: int = 4
     envs_per_actor: int = 1
     actor_mode: str = "thread"
+    # Process-pool scheduling (actor_mode="process" only). "lockstep"
+    # gates every inference wave on every worker; "async" is the
+    # ready-set protocol: inference batches over whichever
+    # `pool_ready_fraction` of workers has reported and lets stragglers
+    # catch up on the next wave (runtime/env_pool.py). Lockstep stays the
+    # default and the test baseline; async is opt-in per preset.
+    pool_mode: str = "lockstep"
+    pool_ready_fraction: float = 0.5
     unroll_length: int = 20
     batch_size: int = 8
     # Fuse K SGD steps into one dispatched XLA program (lax.scan over a
@@ -483,6 +491,10 @@ PROCGEN = ExperimentConfig(
     model="deep_resnet",
     compute_dtype="bfloat16",
     actor_mode="process",
+    # The largest fleet is where one straggler gates 512 envs in lockstep:
+    # ready-set batching over the first 75% of workers (bench.py env_pool
+    # section: >=1.3x under 10% straggler injection, ~parity without).
+    pool_mode="async",
     num_actors=512,
     unroll_length=20,
     batch_size=64,

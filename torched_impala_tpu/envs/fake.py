@@ -8,6 +8,8 @@ the emulators.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 
@@ -225,6 +227,80 @@ class TaskSignalEnv:
         self._t += 1
         self._target = int(self._rng.integers(self._num_actions))
         return self._obs(), reward, self._t >= self._episode_len, False, {}
+
+
+class StragglerEnv:
+    """Wraps another env and injects per-step delays.
+
+    Every step sleeps `base_delay_s` (emulator-cost stand-in), plus
+    `straggler_delay_s` with probability `straggler_prob` — the long-tail
+    stall (GC pause, auto-reset, slow emulator frame) that lockstep env
+    pools serialize onto every wave. The env-pool bench
+    (bench.py run_bench_env_pool) uses this to compare lockstep vs async
+    ready-set scheduling under 0% / 10% straggler injection.
+    """
+
+    def __init__(
+        self,
+        inner,
+        base_delay_s: float = 0.0,
+        straggler_delay_s: float = 0.0,
+        straggler_prob: float = 0.0,
+        seed: int = 0,
+    ):
+        self._inner = inner
+        self._base_delay_s = base_delay_s
+        self._straggler_delay_s = straggler_delay_s
+        self._straggler_prob = straggler_prob
+        self._rng = np.random.default_rng(seed)
+        self.task_id = getattr(inner, "task_id", 0)
+
+    @property
+    def action_space_n(self) -> int:
+        return self._inner.action_space_n
+
+    def reset(self, seed=None):
+        return self._inner.reset(seed=seed)
+
+    def step(self, action):
+        delay = self._base_delay_s
+        if (
+            self._straggler_delay_s > 0.0
+            and self._rng.uniform() < self._straggler_prob
+        ):
+            delay += self._straggler_delay_s
+        if delay > 0.0:
+            time.sleep(delay)
+        return self._inner.step(action)
+
+
+class StragglerFactory:
+    """Picklable env factory that wraps another factory's envs in
+    `StragglerEnv` — delay injection for both thread and process actors."""
+
+    def __init__(
+        self,
+        inner,
+        base_delay_s: float = 0.0,
+        straggler_delay_s: float = 0.0,
+        straggler_prob: float = 0.0,
+    ):
+        self.inner = inner
+        self.base_delay_s = base_delay_s
+        self.straggler_delay_s = straggler_delay_s
+        self.straggler_prob = straggler_prob
+
+    def __call__(self, seed: int, env_index=None):
+        from torched_impala_tpu.envs.factory import call_env_factory
+
+        env = call_env_factory(self.inner, seed, env_index)
+        return StragglerEnv(
+            env,
+            base_delay_s=self.base_delay_s,
+            straggler_delay_s=self.straggler_delay_s,
+            straggler_prob=self.straggler_prob,
+            seed=seed + 17,
+        )
 
 
 class CrashingFactory:

@@ -47,6 +47,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=None,
                    help="'process' runs env workers as OS processes "
                         "(GIL escape) feeding one batched-inference actor")
+    p.add_argument("--pool-mode", choices=("lockstep", "async"),
+                   default=None,
+                   help="process-pool scheduling: 'async' batches "
+                        "inference over the ready fraction of workers "
+                        "instead of gating every wave on stragglers "
+                        "(runtime/env_pool.py)")
+    p.add_argument("--pool-ready-fraction", type=float, default=None,
+                   help="async pool wave size as a fraction of workers "
+                        "(0 < f <= 1; default 0.5)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--unroll-length", type=int, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=None,
@@ -150,6 +159,8 @@ def build_config(args: argparse.Namespace):
         ("num_actors", "num_actors"),
         ("envs_per_actor", "envs_per_actor"),
         ("actor_mode", "actor_mode"),
+        ("pool_mode", "pool_mode"),
+        ("pool_ready_fraction", "pool_ready_fraction"),
         ("batch_size", "batch_size"),
         ("unroll_length", "unroll_length"),
         ("steps_per_dispatch", "steps_per_dispatch"),
@@ -385,6 +396,8 @@ def main(argv=None) -> int:
             max_actor_restarts=args.max_actor_restarts,
             envs_per_actor=cfg.envs_per_actor,
             actor_mode=cfg.actor_mode,
+            pool_mode=cfg.pool_mode,
+            pool_ready_fraction=cfg.pool_ready_fraction,
         )
     finally:
         if profile_ctx is not None:

@@ -6,23 +6,46 @@ env stepping must escape the GIL. The TPU-native shape of that idea is an
 env-worker pool feeding *central batched inference* (the SEED-RL
 decomposition): worker processes own the emulators and nothing else — they
 never import jax, never touch the (fragile, tunnel-backed) accelerator, and
-step E envs each behind a tiny pipe protocol, writing observations into a
-SharedMemory block the parent reads zero-copy. The parent-side
-`VectorActor` then batches policy inference over ALL pooled envs in one
-`[E_total, ...]` jit call and assembles per-env unrolls for the learner —
-trajectory and staleness semantics are unchanged from the thread path.
+step E envs each behind a tiny pipe protocol. ALL per-step payloads live in
+one SharedMemory segment the parent reads/writes zero-copy:
 
-Protocol (per worker, lockstep):
-  parent -> worker : ("step", actions[E] int32 list) | ("close",)
-  worker -> parent : ("stepped", rewards[E], dones[E], events)
-                     with next obs already written to shm; `events` is a
+  [ obs block  [N, *obs_shape] ]  worker-written next observations
+  [ action lane [N] int32      ]  parent-written actions
+  [ reward lane [N] float32    ]  worker-written step rewards
+  [ done   lane [N] bool       ]  worker-written done (= next `first`) flags
+
+so in the steady state the pipe carries only payload-free control tokens,
+error reports, and (rare, episode-boundary) completed-episode events — no
+per-step pickling of actions or rewards.
+
+Protocol (per worker):
+  parent -> worker : ("step",) with actions already in the shm action
+                     lane | ("reset",) | ("close",)
+  worker -> parent : ("stepped", events) with next obs / rewards / dones
+                     already written to their shm lanes; `events` is a
                      list of (env_local_idx, episode_return, episode_len)
                      completed this step. Workers auto-reset finished envs
-                     (envpool-style), so `dones` doubles as next-step
-                     `first` flags.
+                     (envpool-style), so the done lane doubles as the
+                     next-step `first` flags.
   worker -> parent : ("error", repr) then exit — the pool respawns the
                      process (envs are stateless up to the published
                      params) and counts a restart.
+
+Scheduling modes (`mode=`):
+  "lockstep" (default): `step_all(actions)` gates every wave on EVERY
+      worker — one slow env step stalls policy inference for the whole
+      pool.
+  "async": the ready-set protocol (IMPALA's decoupled-actor idea at the
+      pool level; the Podracer ready-set batching shape). The parent
+      drives workers individually via `submit(w, actions)` /
+      `wait_any()`: workers step as soon as their actions land, report
+      completion, and the `VectorActor` batches inference over whichever
+      ready fraction of workers has reported (`ready_fraction`, the knob
+      the actor reads) — stragglers catch up on the next wave instead of
+      gating every wave. Restart semantics cover in-flight workers: a
+      worker that dies (or times out) mid-wave is respawned with reset
+      envs, and its rows come back as a clean episode boundary
+      (reward 0, done True, fresh reset obs) via `ok=False` results.
 
 The env factory must be PICKLABLE (forkserver/spawn start methods):
 module-level functions, functools.partial of them, or
@@ -46,6 +69,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import pickle
 import time
+from multiprocessing import connection as mp_connection
 from multiprocessing import shared_memory
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -70,10 +94,15 @@ except ValueError:  # platform without forkserver
         pass
 
 
+def _align(offset: int, to: int = 8) -> int:
+    return (offset + to - 1) // to * to
+
+
 def _worker_main(
     conn,
     shm_name: str,
     shm_offset: int,
+    lane_offsets: tuple,
     factory_bytes: bytes,
     num_envs: int,
     base_seed: int,
@@ -82,6 +111,13 @@ def _worker_main(
     obs_dtype_str: str,
 ) -> None:
     """Worker process body: build envs, then step on command.
+
+    `lane_offsets` = (action, reward, done) byte offsets of THIS worker's
+    slice of the shared action/reward/done lanes. Per-step data never
+    crosses the pipe: actions are read from the action lane after the
+    ("step",) token arrives, and rewards/dones/next-obs are written to
+    their lanes before the ("stepped", events) ack — the pipe send/recv
+    pair is the happens-before edge that publishes the lane writes.
 
     Deliberately numpy-only: importing the factory may pull in jax as a
     module, but no jax backend is ever initialized here — on this machine
@@ -96,6 +132,19 @@ def _worker_main(
             (num_envs, *obs_shape),
             dtype=obs_dtype,
             buffer=shm.buf[shm_offset : shm_offset + nbytes],
+        )
+        act_off, rew_off, done_off = lane_offsets
+        act_lane = np.ndarray(
+            (num_envs,), np.int32,
+            buffer=shm.buf[act_off : act_off + 4 * num_envs],
+        )
+        rew_lane = np.ndarray(
+            (num_envs,), np.float32,
+            buffer=shm.buf[rew_off : rew_off + 4 * num_envs],
+        )
+        done_lane = np.ndarray(
+            (num_envs,), np.bool_,
+            buffer=shm.buf[done_off : done_off + num_envs],
         )
         factory = pickle.loads(factory_bytes)
         from torched_impala_tpu.envs.factory import call_env_factory
@@ -135,17 +184,14 @@ def _worker_main(
                 conn.send(("reset_done",))
                 continue
             assert msg[0] == "step", msg
-            actions = msg[1]
-            rewards = np.empty((num_envs,), np.float32)
-            dones = np.empty((num_envs,), np.bool_)
             events: List[Tuple[int, float, int]] = []
             for i, env in enumerate(envs):
                 obs, reward, terminated, truncated, _ = env.step(
-                    int(actions[i])
+                    int(act_lane[i])
                 )
                 done = bool(terminated or truncated)
-                rewards[i] = reward
-                dones[i] = done
+                rew_lane[i] = reward
+                done_lane[i] = done
                 ep_return[i] += float(reward)
                 ep_len[i] += 1
                 if done:
@@ -156,7 +202,7 @@ def _worker_main(
                     ep_len[i] = 0
                     obs, _ = env.reset()
                 obs_block[i] = np.asarray(obs)
-            conn.send(("stepped", rewards, dones, events))
+            conn.send(("stepped", events))
     except EOFError:
         pass
     except BaseException as e:  # noqa: BLE001 — must report, then die
@@ -171,11 +217,18 @@ def _worker_main(
 class ProcessEnvPool:
     """W worker processes x E envs each, presented as one batched env.
 
-    Surface consumed by `VectorActor`'s pooled path:
+    Lockstep surface consumed by `VectorActor`'s pooled path:
       num_envs, task_ids, reset_all() -> obs[N], and
       step_all(actions[N]) -> (obs[N], rewards[N], dones[N], events)
     where `dones` are the next-step `first` flags (workers auto-reset) and
     `events` is a list of (global_env_idx, episode_return, episode_len).
+
+    Async (ready-set) surface, used when `mode="async"`:
+      submit(w, actions[E]) -> bool   queue one step for worker w
+      wait_any()           -> [(w, rewards[E], dones[E], events, ok)]
+      read_obs(w)          -> obs[E]  worker w's current obs rows
+    plus `num_workers` / `envs_per_worker` / `ready_fraction` so the
+    driving actor can size its inference waves.
     """
 
     def __init__(
@@ -191,9 +244,19 @@ class ProcessEnvPool:
         first_env_index: int = 0,
         max_restarts: int = 10,
         step_timeout: float = 300.0,
+        mode: str = "lockstep",
+        ready_fraction: float = 0.5,
     ) -> None:
         if num_workers < 1 or envs_per_worker < 1:
             raise ValueError("need >= 1 worker and >= 1 env per worker")
+        if mode not in ("lockstep", "async"):
+            raise ValueError(
+                f"unknown pool mode {mode!r}; expected 'lockstep' or 'async'"
+            )
+        if not 0.0 < ready_fraction <= 1.0:
+            raise ValueError(
+                f"ready_fraction must be in (0, 1], got {ready_fraction}"
+            )
         try:
             self._factory_bytes = pickle.dumps(env_factory)
         except Exception as e:
@@ -212,19 +275,38 @@ class ProcessEnvPool:
         self._first_env_index = first_env_index
         self._max_restarts = max_restarts
         self._step_timeout = step_timeout
+        self.mode = mode
+        self.ready_fraction = ready_fraction
         self.restarts = 0
 
         n = num_workers * envs_per_worker
+        obs_bytes = n * int(np.prod(self._obs_shape)) * self._obs_dtype.itemsize
+        # Lane offsets are 8-byte aligned so the int32/float32 views stay
+        # aligned regardless of the obs block's size.
+        self._act_off = _align(obs_bytes)
+        self._rew_off = _align(self._act_off + 4 * n)
+        self._done_off = _align(self._rew_off + 4 * n)
         self._shm = shared_memory.SharedMemory(
-            create=True,
-            size=max(1, n * int(np.prod(self._obs_shape))
-                     * self._obs_dtype.itemsize),
+            create=True, size=max(1, self._done_off + n)
         )
         self._obs_block = np.ndarray(
             (n, *self._obs_shape), dtype=self._obs_dtype, buffer=self._shm.buf
         )
+        self._act_lane = np.ndarray(
+            (n,), np.int32,
+            buffer=self._shm.buf[self._act_off : self._act_off + 4 * n],
+        )
+        self._rew_lane = np.ndarray(
+            (n,), np.float32,
+            buffer=self._shm.buf[self._rew_off : self._rew_off + 4 * n],
+        )
+        self._done_lane = np.ndarray(
+            (n,), np.bool_,
+            buffer=self._shm.buf[self._done_off : self._done_off + n],
+        )
         self._procs: List[Optional[mp.Process]] = [None] * num_workers
         self._conns: List = [None] * num_workers
+        self._in_flight: set = set()  # workers with an unacked step token
         self.task_ids: List[int] = [0] * n
         self._closed = False
         try:
@@ -256,12 +338,18 @@ class ProcessEnvPool:
         offset = (
             w * E * int(np.prod(self._obs_shape)) * self._obs_dtype.itemsize
         )
+        lane_offsets = (
+            self._act_off + 4 * w * E,
+            self._rew_off + 4 * w * E,
+            self._done_off + w * E,
+        )
         proc = _CTX.Process(
             target=_worker_main,
             args=(
                 child_conn,
                 self._shm.name,
                 offset,
+                lane_offsets,
                 self._factory_bytes,
                 E,
                 self._base_seed + self._seed_stride * (w + 1),
@@ -292,6 +380,7 @@ class ProcessEnvPool:
         return conn.recv()
 
     def _restart(self, w: int, reason: str) -> None:
+        self._in_flight.discard(w)  # a fresh worker has nothing in flight
         if self.restarts >= self._max_restarts:
             raise RuntimeError(
                 f"env worker {w} died ({reason}) and the pool restart "
@@ -312,12 +401,29 @@ class ProcessEnvPool:
     def num_envs(self) -> int:
         return self._num_workers * self._envs_per_worker
 
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    @property
+    def envs_per_worker(self) -> int:
+        return self._envs_per_worker
+
     def reset_all(self) -> np.ndarray:
         """Reset EVERY env (workers re-seed exactly as at spawn) and return
         the initial observations. A respawned inference actor calls this on
         re-attach, so its fresh first=True flags and recurrent state line up
         with true episode starts — a bare shm read would hand it mid-episode
         observations labeled as episode boundaries."""
+        # Drain in-flight async acks first: a respawned inference actor can
+        # re-attach while its predecessor's step commands are still
+        # outstanding, and the reset reply must not race those acks.
+        for w in sorted(self._in_flight):
+            try:
+                self._recv(w)
+            except Exception:
+                pass  # a dead worker repairs through the send path below
+        self._in_flight.clear()
         dead: List[int] = []
         for w in range(self._num_workers):
             try:
@@ -334,7 +440,7 @@ class ProcessEnvPool:
                     raise RuntimeError(
                         f"env worker {w}: unexpected reply {msg!r}"
                     )
-            except (EOFError, TimeoutError, RuntimeError) as e:
+            except (EOFError, OSError, TimeoutError, RuntimeError) as e:
                 self._restart(w, repr(e))
         return np.array(self._obs_block)  # copy out of the shared buffer
 
@@ -351,15 +457,14 @@ class ProcessEnvPool:
         rewards = np.zeros((n,), np.float32)
         dones = np.zeros((n,), np.bool_)
         events: List[Tuple[int, float, int]] = []
-        actions = np.asarray(actions, np.int32)
+        self._act_lane[:] = np.asarray(actions, np.int32)
         # Workers whose command could not even be SENT (abrupt process
         # death between rounds — SIGKILL/OOM) repair through the same path
         # as recv-side failures instead of crashing the inference actor.
         dead: List[int] = []
         for w in range(self._num_workers):
-            sl = self._worker_slice(w)
             try:
-                self._conns[w].send(("step", actions[sl].tolist()))
+                self._conns[w].send(("step",))
             except (BrokenPipeError, OSError) as e:
                 self._restart(w, f"send failed: {e!r}")
                 dead.append(w)
@@ -373,17 +478,118 @@ class ProcessEnvPool:
                 msg = self._recv(w)
                 if msg[0] == "error":
                     raise RuntimeError(f"env worker {w}: {msg[1]}")
-                _, w_rewards, w_dones, w_events = msg
-                rewards[sl] = w_rewards
-                dones[sl] = w_dones
+                assert msg[0] == "stepped", msg
+                rewards[sl] = self._rew_lane[sl]
+                dones[sl] = self._done_lane[sl]
                 base = sl.start
                 events.extend(
-                    (base + i, ret, length) for i, ret, length in w_events
+                    (base + i, ret, length) for i, ret, length in msg[1]
                 )
-            except (EOFError, TimeoutError, RuntimeError) as e:
+            except (EOFError, OSError, TimeoutError, RuntimeError) as e:
                 self._restart(w, repr(e))
                 dones[sl] = True
         return np.array(self._obs_block), rewards, dones, events
+
+    # -- async (ready-set) surface ----------------------------------------
+
+    def submit(self, w: int, actions) -> bool:
+        """Queue one step for worker `w`: write its action-lane slice, send
+        the payload-free step token. Returns True with the step in flight;
+        False when the worker was found dead — it is respawned with reset
+        envs (fresh obs already in shm), NO step is in flight, and the
+        caller should record the transition as a crash episode boundary
+        (reward 0, done True)."""
+        if w in self._in_flight:
+            # A second token would race the worker's action-lane read.
+            raise RuntimeError(
+                f"worker {w} already has a step in flight; wait_any() it "
+                "before submitting again"
+            )
+        sl = self._worker_slice(w)
+        self._act_lane[sl] = np.asarray(actions, np.int32)
+        try:
+            self._conns[w].send(("step",))
+        except (BrokenPipeError, OSError) as e:
+            self._restart(w, f"send failed: {e!r}")
+            return False
+        self._in_flight.add(w)
+        return True
+
+    def _crash_result(self, w: int):
+        E = self._envs_per_worker
+        return (
+            w,
+            np.zeros((E,), np.float32),
+            np.ones((E,), np.bool_),
+            [],
+            False,
+        )
+
+    def wait_any(self, workers=None, timeout: Optional[float] = None):
+        """Block until at least one in-flight worker acks its step; return
+        every ack available as [(w, rewards[E], dones[E], events, ok)].
+
+        `workers` restricts the wait to a subset (default: all in-flight).
+        Dead / erroring / timed-out workers come back with ok=False after
+        an in-line restart: their envs were reset (fresh obs in shm) and
+        the failed step is a clean crash boundary (reward 0, done True).
+        `events` carry GLOBAL env indices, like `step_all`.
+
+        An explicit `timeout` makes the call a bounded poll that returns
+        [] when nothing is ready (timeout=0 = non-blocking sweep of
+        already-buffered acks); only the DEFAULT full step timeout implies
+        dead workers and triggers the repair-all path."""
+        waiting = sorted(
+            self._in_flight if workers is None
+            else self._in_flight & set(workers)
+        )
+        if not waiting:
+            return []
+        poll_only = timeout is not None
+        timeout = self._step_timeout if timeout is None else timeout
+        conn_map = {self._conns[w]: w for w in waiting}
+        ready = mp_connection.wait(list(conn_map), timeout)
+        results = []
+        if not ready:
+            if poll_only:
+                return []
+            # Every waited-on worker has been silent for the full step
+            # timeout — repair them all rather than spin forever.
+            for w in waiting:
+                self._restart(w, f"no step ack within {timeout}s")
+                results.append(self._crash_result(w))
+            return results
+        for conn in ready:
+            w = conn_map[conn]
+            sl = self._worker_slice(w)
+            try:
+                msg = conn.recv()
+                self._in_flight.discard(w)
+                if msg[0] == "error":
+                    raise RuntimeError(f"env worker {w}: {msg[1]}")
+                assert msg[0] == "stepped", msg
+                base = sl.start
+                events = [
+                    (base + i, ret, length) for i, ret, length in msg[1]
+                ]
+                results.append(
+                    (
+                        w,
+                        self._rew_lane[sl].copy(),
+                        self._done_lane[sl].copy(),
+                        events,
+                        True,
+                    )
+                )
+            except (EOFError, OSError, RuntimeError) as e:
+                self._restart(w, repr(e))
+                results.append(self._crash_result(w))
+        return results
+
+    def read_obs(self, w: int) -> np.ndarray:
+        """Copy of worker `w`'s current observation rows (call only after
+        its ack — the ack is the happens-before edge for the shm write)."""
+        return np.array(self._obs_block[self._worker_slice(w)])
 
     def close(self) -> None:
         if self._closed:
@@ -408,6 +614,9 @@ class ProcessEnvPool:
                     conn.close()
                 except Exception:
                     pass
+        # Views into the segment must drop before close() or the buffer
+        # export keeps the mapping alive (BufferError on some platforms).
+        del self._obs_block, self._act_lane, self._rew_lane, self._done_lane
         self._shm.close()
         try:
             self._shm.unlink()

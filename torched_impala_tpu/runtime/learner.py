@@ -137,10 +137,44 @@ class LearnerConfig:
 
 def _put_format(x, fmt):
     """device_put into an XLA-chosen Format; leaves whose format carries
-    no concrete layout (scalars/empty subtrees) take the default put."""
-    if getattr(fmt, "layout", None) is None:
+    no concrete layout (scalars/empty subtrees) take the default put.
+    `.layout` is the Format attribute; `.device_local_layout` its name on
+    pre-Format jax (<= 0.4.x Layout objects)."""
+    concrete = getattr(fmt, "layout", None)
+    if concrete is None:
+        concrete = getattr(fmt, "device_local_layout", None)
+    if concrete is None:
         return jax.device_put(x)
     return jax.device_put(x, fmt)
+
+
+def _auto_format():
+    """The AUTO input-layout marker across jax versions: newer jax spells
+    it Format(Layout.AUTO), pre-Format jax (<= 0.4.x) spells it
+    Layout(DeviceLocalLayout.AUTO). Returns None when neither API exists —
+    auto_layouts then disables itself instead of crashing Learner
+    construction on an ImportError."""
+    try:
+        from jax.experimental.layout import Format, Layout
+
+        return Format(Layout.AUTO)
+    except ImportError:
+        pass
+    try:
+        from jax.experimental.layout import DeviceLocalLayout, Layout
+
+        return Layout(DeviceLocalLayout.AUTO)
+    except ImportError:
+        return None
+
+
+def _input_formats(compiled):
+    """Compiled-executable input formats, under both jax namings
+    (`input_formats`, or `input_layouts` pre-Format)."""
+    formats = getattr(compiled, "input_formats", None)
+    if formats is None:
+        formats = compiled.input_layouts
+    return formats
 
 
 def stack_trajectories(
@@ -453,15 +487,14 @@ class Learner:
         if mesh is None:
             self._train_step = jax.jit(step_impl, donate_argnums=(0, 1, 2))
             if config.auto_layouts and config.data_device is None:
-                from jax.experimental.layout import Format, Layout
-
-                auto = Format(Layout.AUTO)
-                self._auto_jit = jax.jit(
-                    step_impl,
-                    donate_argnums=(0, 1, 2),
-                    in_shardings=auto,
-                    out_shardings=auto,
-                )
+                auto = _auto_format()
+                if auto is not None:  # jax without AUTO layouts: plain jit
+                    self._auto_jit = jax.jit(
+                        step_impl,
+                        donate_argnums=(0, 1, 2),
+                        in_shardings=auto,
+                        out_shardings=auto,
+                    )
         else:
             rep = replicated(mesh)
             bs = batch_sharding(mesh)
@@ -776,7 +809,7 @@ class Learner:
                 *jax.tree.map(aval, state),
                 *jax.tree.map(aval, example_arrays),
             ).compile()
-            fmt_args, _ = compiled.input_formats
+            fmt_args, _ = _input_formats(compiled)
             state_fmts, batch_fmts = fmt_args[:3], fmt_args[3:]
             # One-time on-device relayout of the live state into the
             # compiled formats (donation then keeps in == out formats,
@@ -1086,9 +1119,15 @@ class Learner:
                 self._params, self._opt_state, self._popart_state, *arrays
             )
         except ValueError as e:
+            # Deliberately loose match ('layout', case-insensitive, not
+            # the exact JAX-internal "layouts that disagree" wording): a
+            # JAX upgrade that rewords the message must degrade to the
+            # fallback below — which logs the original error — instead of
+            # turning a recoverable mismatch into a training crash
+            # (ADVICE r5).
             if (
                 self._auto_compiled is None
-                or "layouts that disagree" not in str(e)
+                or "layout" not in str(e).lower()
             ):
                 raise
             # device_put into the compiled Format came back with a
@@ -1256,7 +1295,14 @@ class Learner:
         actors immediately see the restored policy at its restored frame
         count (resume restores the actor-visible param version,
         SURVEY.md §6)."""
+        from torched_impala_tpu.utils.checkpoint import (
+            validate_restored_shapes,
+        )
+
         params = state["params"]
+        # Fail actionably (naming the known r5 padding change) instead of
+        # with a raw tree/shape mismatch deeper in device_put/XLA.
+        validate_restored_shapes(params, self._params, what="params")
         opt_state = state["opt_state"]
         popart_state = state.get("popart_state", self._popart_state)
         if self._config.popart is not None and popart_state != ():
@@ -1267,29 +1313,37 @@ class Learner:
                     popart_state = popart_ops.PopArtState(**popart_state)
                 else:
                     popart_state = popart_ops.PopArtState(*popart_state)
-        if self._mesh is not None:
-            rep = replicated(self._mesh)
-            # Same layouts as construction (tensor-parallel leaves land
-            # back on their shards; DP-only meshes replicate).
-            params = jax.device_put(params, self._param_shardings)
-            opt_state = jax.device_put(opt_state, self._opt_shardings)
-            popart_state = jax.device_put(popart_state, rep)
-        elif self._auto_compiled is not None:
-            # Restored state must land in the compiled step's layouts
-            # (the AOT executable requires exact input formats).
-            fmts = self._state_formats
-            params = jax.tree.map(_put_format, params, fmts[0])
-            opt_state = jax.tree.map(_put_format, opt_state, fmts[1])
-            popart_state = jax.tree.map(
-                _put_format, popart_state, fmts[2]
-            )
-        else:
-            params = jax.device_put(params)
-            opt_state = jax.device_put(opt_state)
-            popart_state = jax.device_put(popart_state)
-        self._params = params
-        self._opt_state = opt_state
-        self._popart_state = popart_state
+        # Under _auto_lock: a restore landing while the batcher thread is
+        # inside _ensure_auto_compiled (a seconds-long AOT compile that
+        # re-lays and writes back a PRE-restore state snapshot) would
+        # otherwise be silently clobbered (ADVICE r5). The lock serializes
+        # the two writers: whichever runs second sees the other's result —
+        # ensure re-reads live state inside the lock, and a restore that
+        # waited for ensure lands in the compiled formats below.
+        with self._auto_lock:
+            if self._mesh is not None:
+                rep = replicated(self._mesh)
+                # Same layouts as construction (tensor-parallel leaves land
+                # back on their shards; DP-only meshes replicate).
+                params = jax.device_put(params, self._param_shardings)
+                opt_state = jax.device_put(opt_state, self._opt_shardings)
+                popart_state = jax.device_put(popart_state, rep)
+            elif self._auto_compiled is not None:
+                # Restored state must land in the compiled step's layouts
+                # (the AOT executable requires exact input formats).
+                fmts = self._state_formats
+                params = jax.tree.map(_put_format, params, fmts[0])
+                opt_state = jax.tree.map(_put_format, opt_state, fmts[1])
+                popart_state = jax.tree.map(
+                    _put_format, popart_state, fmts[2]
+                )
+            else:
+                params = jax.device_put(params)
+                opt_state = jax.device_put(opt_state)
+                popart_state = jax.device_put(popart_state)
+            self._params = params
+            self._opt_state = opt_state
+            self._popart_state = popart_state
         self.num_frames = int(state["num_frames"])
         self.num_steps = int(state["num_steps"])
         if "rng" in state:

@@ -54,6 +54,8 @@ def train(
     max_actor_restarts: Optional[int] = 10,
     envs_per_actor: int = 1,
     actor_mode: str = "thread",
+    pool_mode: str = "lockstep",
+    pool_ready_fraction: float = 0.5,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -81,9 +83,18 @@ def train(
       actor thread — the reference's multiprocess-actor capability in its
       TPU-native (central-inference) shape. Requires a picklable
       `env_factory`.
+
+    `pool_mode` (process mode only) schedules the worker pool:
+    - "lockstep" (default): every inference wave gates on EVERY worker —
+      one slow env step stalls the whole pool.
+    - "async": ready-set batching — inference runs over whichever
+      `pool_ready_fraction` of workers has reported, stragglers catch up
+      on the next wave (runtime/env_pool.py async protocol).
     """
     if actor_mode not in ("thread", "process"):
         raise ValueError(f"unknown actor_mode {actor_mode!r}")
+    if pool_mode not in ("lockstep", "async"):
+        raise ValueError(f"unknown pool_mode {pool_mode!r}")
     device = None
     if actor_device is not None:
         try:
@@ -208,6 +219,8 @@ def train(
                             if max_actor_restarts is not None
                             else 1_000_000
                         ),
+                        mode=pool_mode,
+                        ready_fraction=pool_ready_fraction,
                     )
                 )
         except BaseException:

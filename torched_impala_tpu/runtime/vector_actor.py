@@ -14,12 +14,27 @@ B unrolls may now come from B/E vector actors instead of B scalar ones.
 The LSTM carry rides as one `[E, ...]` state; episode boundaries reset it
 per-row inside the net via the `first` flags (models/nets.py reset-core
 semantics), exactly as in the scalar actor.
+
+Attached to an async (ready-set) `ProcessEnvPool` the actor drops the
+lockstep barrier: each worker carries its own time index, inference runs
+in WAVES over whichever ready fraction of workers has reported
+(`pool.ready_fraction`, e.g. the first 75% of rows), their actions go back
+through the shm action lane, and stragglers catch up on a later wave
+instead of gating every wave. Waves are sized to a fixed worker count so
+the jitted step sees a bounded set of batch shapes; per-env trajectories
+stay time-contiguous because every row of a worker advances exactly once
+per ack, into that worker's own `t` slot of the unroll buffers. The
+trajectory/staleness surface is unchanged — one unroll cycle still emits
+E trajectories against one param snapshot.
 """
 
 from __future__ import annotations
 
+import collections
 import functools
+import math
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -95,6 +110,7 @@ class VectorActor:
         if hasattr(envs, "step_all"):  # batched env (ProcessEnvPool)
             self._pool = envs
             self._envs = []
+            self._pool_async = getattr(envs, "mode", "lockstep") == "async"
             E = self._pool.num_envs
             self._tasks = (
                 [int(t) for t in tasks]
@@ -106,6 +122,7 @@ class VectorActor:
             if not envs:
                 raise ValueError("VectorActor needs at least one env")
             self._pool = None
+            self._pool_async = False
             self._envs = list(envs)
             E = len(self._envs)
             self._tasks = (
@@ -137,6 +154,8 @@ class VectorActor:
 
     def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
         """Step all E envs for T steps; return E single-env trajectories."""
+        if self._pool_async:
+            return self._unroll_async(params, param_version)
         T, E = self._unroll_length, self.num_envs
         if self._device is not None:
             params = jax.device_put(params, self._device)
@@ -220,6 +239,179 @@ class VectorActor:
 
         obs_buf[T] = self._obs
         first_buf[T] = self._first
+
+        return [
+            Trajectory(
+                obs=obs_buf[:, i],
+                first=first_buf[:, i],
+                actions=actions[:, i],
+                behaviour_logits=logits_buf[:, i],
+                rewards=rewards[:, i],
+                cont=cont[:, i],
+                agent_state=jax.tree.map(
+                    lambda x: x[i : i + 1], start_state
+                ),
+                actor_id=self._id,
+                param_version=param_version,
+                task=self._tasks[i],
+            )
+            for i in range(E)
+        ]
+
+    def _unroll_async(self, params, param_version: int) -> List[Trajectory]:
+        """Ready-set unroll against an async `ProcessEnvPool`.
+
+        Every worker carries its own time index `t_w` into the shared
+        `[T+1, E]` unroll buffers; a wave gathers the first `wave_k` ready
+        workers (FIFO by ack arrival — stragglers are served as soon as
+        they report, so no worker starves), runs ONE batched inference
+        over their rows, and writes their actions back through the pool's
+        shm action lane. The unroll ends when every worker reaches T; the
+        only synchronization with stragglers is that (short) tail, not
+        every timestep. Emitted trajectories are bit-compatible with the
+        lockstep path per env row: obs/action/reward/first/cont all share
+        one per-worker time index, so rows stay time-contiguous and
+        `first[t+1]` still mirrors `done[t]`."""
+        T, E = self._unroll_length, self.num_envs
+        pool = self._pool
+        W, Ew = pool.num_workers, pool.envs_per_worker
+        wave_k = max(1, math.ceil(pool.ready_fraction * W))
+        if self._device is not None:
+            params = jax.device_put(params, self._device)
+        obs_buf = np.empty((T + 1, E, *self._obs.shape[1:]), self._obs.dtype)
+        first_buf = np.empty((T + 1, E), np.bool_)
+        actions = np.empty((T, E), np.int32)
+        rewards = np.empty((T, E), np.float32)
+        cont = np.empty((T, E), np.float32)
+        logits_buf = None
+        start_state = host_snapshot(self._state)
+        obs_buf[0] = self._obs
+        first_buf[0] = self._first
+
+        def slc(w: int) -> slice:
+            return slice(w * Ew, (w + 1) * Ew)
+
+        def advance(w: int, step_rewards, dones, events, timed=True) -> None:
+            # Record worker w's completed step t_w[w] and move it to
+            # t_w[w] + 1 (its rows' next obs/first are now current).
+            nonlocal completed, ewma_step
+            if timed:
+                dur = time.monotonic() - submit_t[w]
+                if ewma_step is None:
+                    ewma_step = dur
+                elif dur < 2.0 * ewma_step:
+                    # Track the NORMAL step time only: straggler stalls
+                    # must not inflate the grace window that exists to
+                    # absorb sub-stall arrival jitter (a stall-inflated
+                    # grace would re-serialize the pool on its stragglers).
+                    ewma_step = 0.8 * ewma_step + 0.2 * dur
+            t = int(t_w[w])
+            sl = slc(w)
+            rewards[t, sl] = step_rewards
+            cont[t, sl] = np.where(dones, 0.0, 1.0)
+            obs = pool.read_obs(w)
+            obs_buf[t + 1, sl] = obs
+            first_buf[t + 1, sl] = dones
+            self._obs[sl] = obs
+            self._first[sl] = dones
+            t_w[w] = t + 1
+            if self._on_episode_return is not None:
+                for _, ret, length in events:
+                    self._on_episode_return(self._id, ret, length)
+            if t + 1 >= T:
+                completed += 1
+            else:
+                actionable.append(w)
+
+        t_w = np.zeros((W,), np.int64)
+        submit_t = np.zeros((W,), np.float64)
+        ewma_step = None  # EWMA of submit->ack worker step seconds
+        # No step is ever in flight between unrolls (the previous cycle's
+        # tail drained every ack), so all workers start actionable at t=0.
+        actionable = collections.deque(range(W))
+        completed = 0
+        while completed < W:
+            # The ready-set gate: wait for acks only until the FIRST
+            # `wave_k` workers (or every straggler left below T) are
+            # ready — never for the whole pool.
+            target = min(wave_k, W - completed)
+            while len(actionable) < target:
+                for w, rw, dn, events, _ok in pool.wait_any():
+                    advance(w, rw, dn, events)
+                target = min(wave_k, W - completed)
+            # Grace window: once the ready fraction is met, wait one short
+            # self-tuned beat (a fraction of the EWMA worker step time)
+            # for the nearly-done rest. A pool with NO stragglers then
+            # coalesces into ONE full-batch call per timestep — lockstep-
+            # parity throughput instead of fragmenting into wave_k pieces
+            # — while a genuine straggler costs its wave only the grace,
+            # never its full stall. wait_any with an explicit timeout is a
+            # bounded poll (no repair sweep), so an expired grace just
+            # launches the partial wave.
+            if ewma_step is not None:
+                deadline = time.monotonic() + 0.25 * ewma_step
+                while completed + len(actionable) < W:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0:
+                        break
+                    acks = pool.wait_any(timeout=budget)
+                    if not acks:
+                        break
+                    for w, rw, dn, events, _ok in acks:
+                        advance(w, rw, dn, events)
+            else:
+                for w, rw, dn, events, _ok in pool.wait_any(timeout=0):
+                    advance(w, rw, dn, events)
+            remaining = W - completed
+            if remaining == 0:
+                break
+            # Full wave when EVERY remaining worker is ready (one extra
+            # compiled shape); otherwise exactly wave_k so the jitted step
+            # sees a bounded shape set while stragglers catch up.
+            take = (
+                len(actionable)
+                if len(actionable) == remaining
+                else min(wave_k, len(actionable))
+            )
+            wave = [actionable.popleft() for _ in range(take)]
+            rows = np.concatenate([np.arange(w * Ew, (w + 1) * Ew)
+                                   for w in wave])
+            wave_state = jax.tree.map(lambda x: x[rows], self._state)
+            self._key, out = self._step_fn(
+                params,
+                self._key,
+                self._obs[rows],
+                self._first[rows],
+                wave_state,
+            )
+            self._state = jax.tree.map(
+                lambda full, new: full.at[rows].set(new),
+                self._state,
+                out.state,
+            )
+            acts = np.asarray(out.action)
+            if logits_buf is None:
+                logits_buf = np.empty(
+                    (T, E, out.policy_logits.shape[-1]), np.float32
+                )
+            wave_logits = np.asarray(out.policy_logits)
+            for j, w in enumerate(wave):
+                t, sl = int(t_w[w]), slc(w)
+                seg = slice(j * Ew, (j + 1) * Ew)
+                actions[t, sl] = acts[seg]
+                logits_buf[t, sl] = wave_logits[seg]
+                submit_t[w] = time.monotonic()
+                if not pool.submit(w, acts[seg]):
+                    # Dead worker, repaired by the pool: its envs were
+                    # reset, so the submitted action resolves as a crash
+                    # episode boundary instead of a stalled wave.
+                    advance(
+                        w,
+                        np.zeros((Ew,), np.float32),
+                        np.ones((Ew,), np.bool_),
+                        [],
+                        timed=False,
+                    )
 
         return [
             Trajectory(

@@ -55,6 +55,49 @@ def jnp_issubdtype_prng(x: Any) -> bool:
         return False
 
 
+# The known param-shape break: round 5 changed AtariShallowTorso conv
+# padding SAME -> VALID, shrinking the flattened conv output (Dense_0
+# kernel 7744 -> 3136 rows), so round-1-4 checkpoints no longer match the
+# live net. Mentioned by every shape-mismatch error below so the failure
+# is actionable instead of a raw pytree/shape dump.
+_SHAPE_MISMATCH_HINT = (
+    "Known cause: checkpoints written before round 5 used SAME-padded "
+    "AtariShallowTorso convs (Dense_0 kernel 7744 rows; r5 switched to "
+    "VALID padding, 3136 rows) — retrain or restore with the matching "
+    "model revision."
+)
+
+
+def validate_restored_shapes(restored, live, *, what: str = "state") -> None:
+    """Raise an actionable ValueError when a restored pytree's structure or
+    leaf shapes disagree with the live tree it is about to replace."""
+    restored_paths = {
+        jax.tree_util.keystr(path): np.shape(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
+    }
+    live_paths = {
+        jax.tree_util.keystr(path): np.shape(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(live)[0]
+    }
+    problems = []
+    for key in sorted(set(restored_paths) | set(live_paths)):
+        r, l = restored_paths.get(key), live_paths.get(key)
+        if r is None:
+            problems.append(f"{key}: missing from the restored tree")
+        elif l is None:
+            problems.append(f"{key}: not present in the live tree")
+        elif r != l:
+            problems.append(f"{key}: restored {r} vs live {l}")
+    if problems:
+        detail = "; ".join(problems[:8])
+        if len(problems) > 8:
+            detail += f"; ... ({len(problems) - 8} more)"
+        raise ValueError(
+            f"restored {what} tree does not match the live {what} "
+            f"({detail}). {_SHAPE_MISMATCH_HINT}"
+        )
+
+
 class Checkpointer:
     """Thin wrapper over `ocp.CheckpointManager` for learner-state pytrees.
 
@@ -116,7 +159,7 @@ class Checkpointer:
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
-        except BaseException:
+        except BaseException as e:
             # Back-compat: checkpoints written before the 'rng' entry was
             # added lack that key, and StandardRestore requires structural
             # match — retry without it (set_state treats rng as optional).
@@ -124,10 +167,34 @@ class Checkpointer:
                 reduced = {
                     k: v for k, v in abstract.items() if k != "rng"
                 }
-                return self._mgr.restore(
-                    step, args=ocp.args.StandardRestore(reduced)
-                )
-            raise
+                try:
+                    return self._mgr.restore(
+                        step, args=ocp.args.StandardRestore(reduced)
+                    )
+                except BaseException as e2:
+                    wrapped = self._annotate_restore_error(e2)
+                    if wrapped is e2:
+                        raise
+                    raise wrapped from e2
+            wrapped = self._annotate_restore_error(e)
+            if wrapped is e:
+                raise
+            raise wrapped from e
+
+    @staticmethod
+    def _annotate_restore_error(e: BaseException) -> BaseException:
+        """Orbax surfaces checkpoint-vs-live mismatches as raw tree/shape
+        errors (the restore target's avals come from the LIVE state); wrap
+        those with the known r5 padding-change hint so the failure tells
+        the operator what to do."""
+        msg = str(e).lower()
+        if any(k in msg for k in ("shape", "structure", "tree", "dtype")):
+            return ValueError(
+                "checkpoint restore failed with a tree/shape mismatch "
+                f"against the live learner state: {e}. "
+                f"{_SHAPE_MISMATCH_HINT}"
+            )
+        return e
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
