@@ -277,6 +277,9 @@ def main(args) -> None:
     # Host-side section (no TPU involved): lockstep vs async ready-set
     # pool scheduling under straggler injection.
     section("env_pool", lambda: run_bench_env_pool(jax))
+    # Host-side: telemetry registry overhead on the env-pool hot path
+    # (ISSUE 2 acceptance: < 2% of env-pool steps/s with telemetry on).
+    section("telemetry", lambda: run_bench_telemetry(jax))
     section("e2e_components", lambda: run_e2e_components(jax))
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
@@ -1477,6 +1480,141 @@ def run_bench_env_pool(jax) -> dict:
             "async_vs_lockstep": round(async_sps / lockstep, 3),
         }
         log(f"bench: env_pool {tag}: {out[tag]}")
+    return out
+
+
+def run_bench_telemetry(jax) -> dict:
+    """Telemetry-registry overhead (ISSUE 2 acceptance: < 2%).
+
+    Two measurements:
+    1. raw per-record cost of each metric kind (ns/op, single thread) —
+       the intrinsic hot-path price;
+    2. env-pool steps/s through the instrumented VectorActor+
+       ProcessEnvPool pipeline with the global registry ENABLED vs
+       DISABLED (`telemetry.set_enabled`) — the end-to-end overhead the
+       acceptance bound is written against. Envs run with a small 1ms
+       base delay (no stragglers) so per-step telemetry cost is measured
+       against a realistic-but-tight step budget instead of vanishing
+       under a slow emulator.
+
+    Host-side only: no TPU needed; inference pinned to the CPU backend
+    when present (same protocol as the env_pool section)."""
+    import numpy as np
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.envs.fake import StragglerFactory
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
+    from torched_impala_tpu.runtime.param_store import ParamStore
+    from torched_impala_tpu.runtime.vector_actor import VectorActor
+    from torched_impala_tpu.telemetry import Registry, set_enabled
+
+    # 1. raw per-op costs on a fresh registry. Metric objects resolve
+    # OUTSIDE the timed loop, exactly like the real call sites do.
+    reg = Registry()
+    c = reg.counter("bench/counter")
+    g = reg.gauge("bench/gauge")
+    t = reg.timer("bench/timer")
+    h = reg.histogram("bench/hist_ms")
+    ops = {
+        "counter_inc": lambda: c.inc(),
+        "gauge_set": lambda: g.set(1.0),
+        "timer_observe": lambda: t.observe(1e-3),
+        "hist_observe": lambda: h.observe(3.7),
+    }
+    N = 200_000
+    raw_ns = {}
+    for name, op in ops.items():
+        t0 = time.perf_counter()
+        for _ in range(N):
+            op()
+        raw_ns[name] = round((time.perf_counter() - t0) / N * 1e9, 1)
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        reg.snapshot()
+    raw_ns["snapshot_us"] = round(
+        (time.perf_counter() - t0) / 1000 * 1e6, 1
+    )
+    log(f"bench: telemetry raw ops: {raw_ns}")
+
+    # 2. end-to-end env-pool throughput, registry on vs off.
+    W, E, T, unrolls = 4, 4, 20, 3
+    inner = configs.make_env_factory(
+        configs.ExperimentConfig(
+            name="bench_telemetry",
+            env_family="cartpole",
+            obs_shape=(8,),
+            num_actions=4,
+        ),
+        fake=True,
+    )
+    factory = StragglerFactory(
+        inner, base_delay_s=1e-3, straggler_delay_s=0.0, straggler_prob=0.0
+    )
+    agent = Agent(
+        ImpalaNet(num_actions=4, torso=MLPTorso(hidden_sizes=(64,)))
+    )
+    params = agent.init_params(
+        jax.random.key(0), np.zeros((8,), np.float32)
+    )
+    store = ParamStore()
+    store.publish(0, params)
+    try:
+        device = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        device = None
+
+    def measure(enabled: bool) -> float:
+        set_enabled(enabled)
+        pool = ProcessEnvPool(
+            env_factory=factory,
+            num_workers=W,
+            envs_per_worker=E,
+            obs_shape=(8,),
+            obs_dtype=np.float32,
+            mode="async",
+            ready_fraction=0.5,
+        )
+        try:
+            actor = VectorActor(
+                actor_id=0,
+                envs=pool,
+                agent=agent,
+                param_store=store,
+                enqueue=lambda t: None,
+                unroll_length=T,
+                seed=0,
+                device=device,
+            )
+            actor.unroll_and_push()  # warmup: compiles wave shapes
+            t0 = time.perf_counter()
+            for _ in range(unrolls):
+                actor.unroll_and_push()
+            dt = time.perf_counter() - t0
+            return unrolls * T * pool.num_envs / dt
+        finally:
+            pool.close()
+            set_enabled(True)
+
+    # Interleaved arms, best-of-3 each: pool spawn + OS scheduling noise
+    # on this 1-core box exceeds the ~0.3% effect being measured, and max
+    # (the least-interrupted run) is the standard noise filter for
+    # throughput arms.
+    on, off = [], []
+    for _ in range(3):
+        on.append(measure(True))
+        off.append(measure(False))
+    sps_on, sps_off = max(on), max(off)
+    out = {
+        "raw_ns_per_op": raw_ns,
+        "pool": f"{W}x{E} envs, T={T}, async, 1ms base delay",
+        "env_steps_per_sec_on": round(sps_on, 1),
+        "env_steps_per_sec_off": round(sps_off, 1),
+        "overhead_pct": round((1.0 - sps_on / sps_off) * 100.0, 2),
+    }
+    log(f"bench: telemetry overhead: {out['overhead_pct']}% "
+        f"(on {out['env_steps_per_sec_on']} vs off "
+        f"{out['env_steps_per_sec_off']} steps/s)")
     return out
 
 

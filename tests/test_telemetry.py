@@ -1,0 +1,533 @@
+"""Telemetry subsystem: registry, watchdog, profiler capture, pipeline
+integration (ISSUE 2)."""
+
+import io
+import importlib.util
+import json
+import math
+import os
+import queue
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torched_impala_tpu.telemetry import (
+    ProfilerCapture,
+    Registry,
+    StallWatchdog,
+    StepWindowProfiler,
+    parse_profile_steps,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- registry -----------------------------------------------------------
+
+
+def test_counter_concurrent_increments():
+    reg = Registry()
+    c = reg.counter("test/hits")
+    threads = [
+        threading.Thread(
+            target=lambda: [c.inc() for _ in range(10_000)]
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 80_000
+    assert reg.snapshot()["telemetry/test/hits"] == 80_000
+
+
+def test_histogram_bucket_edges():
+    reg = Registry()
+    h = reg.histogram("test/lat_ms", buckets=(1.0, 2.0, 5.0))
+    # Upper edges are inclusive: 1.0 lands in the first bucket, 1.0001 in
+    # the second, 5.0 in the third, 7.0 in the +inf tail.
+    for v in (0.5, 1.0, 1.5, 2.0, 5.0, 7.0):
+        h.observe(v)
+    assert h.count == 6
+    assert h._counts == [2, 2, 1, 1]
+    snap = reg.snapshot()
+    assert snap["telemetry/test/lat_ms_count"] == 6
+    assert snap["telemetry/test/lat_ms_max"] == 7.0
+    assert snap["telemetry/test/lat_ms_mean"] == pytest.approx(17.0 / 6)
+    # p50: rank 3 of 6 falls at the top of bucket 2 (upper edge 2.0).
+    assert 1.0 <= snap["telemetry/test/lat_ms_p50"] <= 2.0
+    # p95: rank 5.7 of 6 falls in the +inf bucket, which reports max.
+    assert snap["telemetry/test/lat_ms_p95"] == 7.0
+
+
+def test_histogram_empty_is_nan_not_crash():
+    reg = Registry()
+    reg.histogram("test/empty_ms")
+    snap = reg.snapshot()
+    assert snap["telemetry/test/empty_ms_count"] == 0
+    assert math.isnan(snap["telemetry/test/empty_ms_p95"])
+    assert math.isnan(snap["telemetry/test/empty_ms_mean"])
+
+
+def test_histogram_rejects_bad_buckets():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        reg.histogram("test/bad", buckets=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        reg.histogram("test/bad2", buckets=())
+
+
+def test_snapshot_while_writing():
+    reg = Registry()
+    c = reg.counter("test/spins")
+    h = reg.histogram("test/spin_ms")
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            c.inc()
+            h.observe(1.5)
+            reg.gauge("test/depth").set(3.0)
+            reg.heartbeat("hammer")
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        last = -1
+        for _ in range(200):
+            snap = reg.snapshot()
+            v = snap["telemetry/test/spins"]
+            assert v >= last  # counter is monotone, never torn
+            last = v
+            assert (
+                snap["telemetry/test/spin_ms_count"] >= 0
+            )
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert reg.last_heartbeat() is not None
+
+
+def test_same_name_same_type_shares_metric():
+    reg = Registry()
+    assert reg.counter("a/b") is reg.counter("a/b")
+
+
+def test_type_conflict_raises():
+    reg = Registry()
+    reg.counter("test/thing")
+    with pytest.raises(TypeError, match="already registered as counter"):
+        reg.gauge("test/thing")
+    # span() registers a timer under the hood: same-name timer is fine,
+    # but a histogram is a conflict.
+    with reg.span("test/block"):
+        pass
+    assert reg.timer("test/block") is not None
+    with pytest.raises(TypeError):
+        reg.histogram("test/block")
+
+
+@pytest.mark.parametrize(
+    "bad", ["noslash", "Upper/case", "a/b/c", "a/", "/b", "a b/c"]
+)
+def test_malformed_names_rejected(bad):
+    with pytest.raises(ValueError):
+        Registry().counter(bad)
+
+
+def test_gauge_fn_reads_lazily():
+    reg = Registry()
+    q: queue.Queue = queue.Queue()
+    reg.gauge("test/qdepth", fn=q.qsize)
+    assert reg.snapshot()["telemetry/test/qdepth"] == 0
+    q.put(1)
+    q.put(2)
+    assert reg.snapshot()["telemetry/test/qdepth"] == 2
+
+
+def test_span_times_block():
+    reg = Registry()
+    with reg.span("test/sleepy"):
+        time.sleep(0.02)
+    snap = reg.snapshot()
+    assert snap["telemetry/test/sleepy_calls"] == 1
+    assert snap["telemetry/test/sleepy_ms"] >= 15.0
+
+
+def test_disabled_registry_is_noop():
+    reg = Registry(enabled=False)
+    c = reg.counter("test/hits")
+    c.inc()
+    reg.heartbeat("x")
+    assert c.value == 0
+    assert reg.last_heartbeat() is None
+    reg.enabled = True
+    c.inc()
+    assert c.value == 1
+
+
+# ---- stall watchdog -----------------------------------------------------
+
+
+def test_watchdog_fires_on_wedged_queue():
+    """The acceptance scenario: a producer wedged on a full queue whose
+    consumer never drains it. The watchdog must dump thread stacks (the
+    wedged frame visible), dump the snapshot, count the stall, and emit
+    the event through on_stall."""
+    reg = Registry()
+    reg.counter("test/progress").inc()
+    reg.heartbeat("learner")  # one beat, then silence = the wedge
+
+    wedged_q: queue.Queue = queue.Queue(maxsize=1)
+    wedged_q.put("full")
+    release = threading.Event()
+
+    def wedged_enqueue_producer():
+        # Blocks forever on the full queue (until the test releases it).
+        while not release.is_set():
+            try:
+                wedged_q.put("next", timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    producer = threading.Thread(
+        target=wedged_enqueue_producer, name="wedged-producer"
+    )
+    producer.start()
+    events = []
+    stream = io.StringIO()
+    dog = StallWatchdog(
+        reg,
+        deadline_s=0.3,
+        poll_s=0.05,
+        on_stall=events.append,
+        stream=stream,
+    )
+    try:
+        dog.start()
+        assert dog.fired.wait(timeout=5.0), "watchdog never fired"
+    finally:
+        dog.stop()
+        release.set()
+        wedged_q.get_nowait()
+        producer.join()
+    dump = stream.getvalue()
+    assert "STALL" in dump and "no pipeline heartbeat" in dump
+    assert "learner=" in dump  # the last-beats report
+    assert "thread stacks" in dump
+    assert "wedged-producer" in dump  # the wedged thread is visible
+    assert "wedged_enqueue_producer" in dump  # ... down to its frame
+    assert "registry snapshot" in dump
+    assert "telemetry/test/progress=1" in dump
+    assert reg.snapshot()["telemetry/watchdog/stall"] == 1
+    assert len(events) == 1
+    assert events[0]["telemetry/watchdog/stall"] == 1
+    assert events[0]["telemetry/watchdog/stalled_for_s"] >= 0.3
+
+
+def test_watchdog_quiet_while_heartbeats_flow_then_rearms():
+    reg = Registry()
+    stream = io.StringIO()
+    dog = StallWatchdog(reg, deadline_s=0.4, poll_s=0.05, stream=stream)
+    try:
+        dog.start()
+        for _ in range(8):  # healthy phase: beats inside the deadline
+            reg.heartbeat("actor")
+            time.sleep(0.05)
+        assert not dog.fired.is_set()
+        assert dog.fired.wait(timeout=5.0)  # silence -> first stall
+        assert stream.getvalue().count("STALL") == 1
+        time.sleep(0.3)  # still silent: must NOT re-dump the same stall
+        assert stream.getvalue().count("STALL") == 1
+        reg.heartbeat("actor")  # progress resumes -> re-arms
+        time.sleep(0.15)
+        assert dog._stall_active is False
+    finally:
+        dog.stop()
+
+
+def test_watchdog_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        StallWatchdog(Registry(), deadline_s=0.0)
+
+
+# ---- profiler capture ---------------------------------------------------
+
+
+def test_parse_profile_steps():
+    assert parse_profile_steps("0:3") == (0, 3)
+    assert parse_profile_steps("100:250") == (100, 250)
+    for bad in ("3", "a:b", "5:5", "7:3", "-1:4", "1:2:3"):
+        with pytest.raises(ValueError):
+            parse_profile_steps(bad)
+
+
+class _FakeCapture:
+    def __init__(self):
+        self.calls = []
+
+    def start(self, tag=None):
+        self.calls.append(("start", tag))
+
+    def stop(self):
+        self.calls.append(("stop", None))
+
+
+def test_step_window_opens_and_closes_on_edges():
+    cap = _FakeCapture()
+    win = StepWindowProfiler(cap, start_step=2, stop_step=5)
+    for s in (1, 2, 3, 4, 5, 6, 7):
+        win.on_step(s)
+    assert cap.calls == [("start", "steps_2_5"), ("stop", None)]
+
+
+def test_step_window_opens_immediately_when_start_is_past():
+    # A resumed run restored beyond start_step: the initial callback
+    # (loop.py fires one with the restored count) opens the window.
+    cap = _FakeCapture()
+    win = StepWindowProfiler(cap, start_step=2, stop_step=10)
+    win.on_step(7)
+    assert cap.calls == [("start", "steps_2_10")]
+    win.close()  # budget ended before stop_step: flush, don't lose it
+    assert cap.calls[-1] == ("stop", None)
+
+
+def test_step_window_validates_range():
+    with pytest.raises(ValueError):
+        StepWindowProfiler(_FakeCapture(), 5, 5)
+
+
+def test_profiler_capture_writes_trace(tmp_path):
+    cap = ProfilerCapture(str(tmp_path / "traces"))
+    import jax
+    import jax.numpy as jnp
+
+    path = cap.start(tag="t")
+    assert cap.active and path.endswith("/t")
+    assert cap.start() is None  # single global trace at a time
+    jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+    assert cap.stop() == path
+    assert not cap.active
+    assert cap.stop() is None
+    files = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(path)
+        for f in fs
+    ]
+    assert files, "trace directory is empty"
+
+
+@pytest.mark.skipif(
+    not hasattr(signal, "SIGUSR1"), reason="platform without SIGUSR1"
+)
+def test_sigusr1_toggles_capture(tmp_path):
+    cap = ProfilerCapture(str(tmp_path / "traces"))
+    assert cap.install_sigusr1()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 5
+        while not cap.active and time.time() < deadline:
+            time.sleep(0.01)
+        assert cap.active
+        os.kill(os.getpid(), signal.SIGUSR1)
+        while cap.active and time.time() < deadline:
+            time.sleep(0.01)
+        assert not cap.active
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+        if cap.active:
+            cap.stop()
+
+
+# ---- metric-name lint (tools/check_metric_names.py) ---------------------
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names",
+        os.path.join(REPO, "tools", "check_metric_names.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_name_lint_clean():
+    lint = _load_lint()
+    errors = lint.check(REPO)
+    assert errors == [], "\n".join(errors)
+
+
+def test_metric_name_lint_catches_violations(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "torched_impala_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        'reg.counter("NoSlash")\n'
+        'reg.gauge("pool/depth")\n'
+        'reg.timer("pool/depth")\n'  # type fork with the gauge above
+        'x = "telemetry/bad key here"\n'  # prose, must NOT flag
+        'y = "telemetry/bad/Key"\n'  # malformed literal, not flagged
+        'z = "telemetry/ok/key"\n'
+    )
+    errors = lint.check(str(tmp_path))
+    joined = "\n".join(errors)
+    assert "NoSlash" in joined
+    assert "registered it as gauge" in joined
+    assert len(errors) == 2
+
+
+# ---- pipeline integration ----------------------------------------------
+
+
+def _jsonl_keys(path):
+    keys = set()
+    with open(path) as f:
+        for line in f:
+            keys.update(json.loads(line).keys())
+    return keys
+
+
+def test_train_emits_telemetry_through_jsonl(tmp_path):
+    """Acceptance: a CPU fake-env run emits telemetry/pool/*, actor/*,
+    queue/*, and learner/* keys through JSONLinesLogger (process-mode
+    pool so all four stages exist)."""
+    import optax
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.runtime.loop import train
+    from torched_impala_tpu.utils.loggers import JSONLinesLogger
+
+    cfg = configs.ExperimentConfig(
+        name="telemetry_it",
+        env_family="cartpole",
+        obs_shape=(4,),
+        num_actions=2,
+        num_actors=2,
+        envs_per_actor=2,
+        actor_mode="process",
+        pool_mode="async",
+        pool_ready_fraction=0.5,
+        unroll_length=5,
+        batch_size=4,
+        lr=1e-3,
+        lr_anneal=False,
+    )
+    path = str(tmp_path / "telemetry.jsonl")
+    logger = JSONLinesLogger(path)
+    try:
+        result = train(
+            agent=configs.make_agent(cfg),
+            env_factory=configs.make_env_factory(cfg, fake=True),
+            example_obs=configs.example_obs(cfg),
+            num_actors=cfg.num_actors,
+            learner_config=configs.make_learner_config(cfg),
+            optimizer=optax.sgd(1e-3),
+            total_steps=4,
+            logger=logger,
+            log_every=2,
+            envs_per_actor=cfg.envs_per_actor,
+            actor_mode="process",
+            pool_mode="async",
+            telemetry_interval=1,
+            stall_timeout=120.0,
+        )
+    finally:
+        logger.close()
+    assert result.learner.num_steps == 4
+    keys = _jsonl_keys(path)
+    for ns in ("pool", "actor", "queue", "learner"):
+        assert any(
+            k.startswith(f"telemetry/{ns}/") for k in keys
+        ), f"missing telemetry/{ns}/* in {sorted(keys)}"
+    # The load-bearing series from the ISSUE are all present.
+    for key in (
+        "telemetry/pool/worker_step_ms_p95",
+        "telemetry/pool/restarts",
+        "telemetry/pool/lane_occupancy",
+        "telemetry/actor/wave_latency_ms_p95",
+        "telemetry/actor/ready_fraction_achieved",
+        "telemetry/queue/depth",
+        "telemetry/queue/enqueue_block_ms_p95",
+        "telemetry/learner/train_step_ms",
+        "telemetry/learner/param_lag_frames",
+        "telemetry/watchdog/stall",
+    ):
+        assert key in keys, f"{key} missing from {sorted(keys)}"
+
+
+def test_telemetry_interval_throttles_merge(tmp_path):
+    """telemetry_interval=0 disables the snapshot merge entirely."""
+    import optax
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.runtime.loop import train
+    from torched_impala_tpu.utils.loggers import JSONLinesLogger
+
+    cfg = configs.CARTPOLE
+    path = str(tmp_path / "quiet.jsonl")
+    logger = JSONLinesLogger(path)
+    try:
+        train(
+            agent=configs.make_agent(cfg),
+            env_factory=configs.make_env_factory(cfg, fake=True),
+            example_obs=configs.example_obs(cfg),
+            num_actors=1,
+            learner_config=configs.make_learner_config(cfg),
+            optimizer=optax.sgd(1e-3),
+            total_steps=2,
+            logger=logger,
+            log_every=1,
+            telemetry_interval=0,
+        )
+    finally:
+        logger.close()
+    keys = _jsonl_keys(path)
+    assert keys and not any(k.startswith("telemetry/") for k in keys)
+
+
+def test_cli_profile_steps_writes_trace(tmp_path):
+    """Acceptance: --profile-steps produces a non-empty trace directory
+    on CPU."""
+    from torched_impala_tpu.run import main
+
+    trace_dir = str(tmp_path / "traces")
+    rc = main(
+        [
+            "--config", "cartpole",
+            "--fake-envs",
+            "--total-steps", "4",
+            "--log-every", "2",
+            "--logger", "null",
+            "--num-actors", "1",
+            "--profile-steps", "1:3",
+            "--trace-dir", trace_dir,
+        ]
+    )
+    assert rc == 0
+    window = os.path.join(trace_dir, "steps_1_3")
+    files = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(window)
+        for f in fs
+    ]
+    assert files, f"no trace files under {window}"
+
+
+def test_cli_rejects_bad_profile_steps():
+    from torched_impala_tpu.run import main
+
+    with pytest.raises(SystemExit, match="profile-steps"):
+        main(
+            [
+                "--config", "cartpole", "--fake-envs",
+                "--logger", "null", "--profile-steps", "9:2",
+            ]
+        )

@@ -35,11 +35,53 @@ def test_csv_logger_roundtrip(tmp_path):
     path = str(tmp_path / "log.csv")
     lg = CSVLogger(path)
     lg.write({"a": 1.0, "b": 2})
-    lg.write({"a": 3.0, "b": 4, "ignored_new_key": 9})
+    lg.write({"a": 3.0, "b": 4})
     lg.close()
     lines = open(path).read().strip().splitlines()
     assert lines[0] == "a,b"
     assert lines[1] == "1.0,2" and lines[2] == "3.0,4"
+
+
+def test_csv_logger_widens_header_on_new_keys(tmp_path):
+    # Keys unseen at first write used to be silently dropped; now the
+    # file is rewritten once with the widened header (old rows blank in
+    # the new columns, existing columns unmoved).
+    path = str(tmp_path / "log.csv")
+    lg = CSVLogger(path)
+    lg.write({"a": 1.0, "b": 2})
+    lg.write({"a": 3.0, "b": 4, "late_key": 9})
+    lg.write({"a": 5.0, "b": 6, "late_key": 10})
+    lg.close()
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "a,b,late_key"
+    assert lines[1] == "1.0,2,"  # pre-widening row: blank new column
+    assert lines[2] == "3.0,4,9" and lines[3] == "5.0,6,10"
+
+
+def test_csv_logger_appends_to_existing_file(tmp_path):
+    # Resumed runs must extend the CSV, not clobber it (JSONLinesLogger
+    # parity); the header comes from the existing file.
+    path = str(tmp_path / "log.csv")
+    lg = CSVLogger(path)
+    lg.write({"a": 1.0, "b": 2})
+    lg.close()
+    lg2 = CSVLogger(path)
+    lg2.write({"a": 3.0, "b": 4})
+    lg2.close()
+    lines = open(path).read().strip().splitlines()
+    assert lines == ["a,b", "1.0,2", "3.0,4"]
+
+
+def test_csv_logger_resume_with_new_keys_preserves_history(tmp_path):
+    path = str(tmp_path / "log.csv")
+    lg = CSVLogger(path)
+    lg.write({"a": 1.0})
+    lg.close()
+    lg2 = CSVLogger(path)
+    lg2.write({"a": 2.0, "c": 7})  # resumed run learned a new series
+    lg2.close()
+    lines = open(path).read().strip().splitlines()
+    assert lines == ["a,c", "1.0,", "2.0,7"]
 
 
 def test_jsonl_logger(tmp_path):
@@ -69,6 +111,46 @@ def test_multi_logger_fans_out(tmp_path):
     lg.close()
     assert "a=1" in buf.getvalue()
     assert open(csv_path).read().startswith("a")
+
+
+class _ExplodingLogger(NullLogger):
+    def __init__(self):
+        self.writes = 0
+
+    def write(self, metrics):
+        self.writes += 1
+        raise RuntimeError("disk full")
+
+
+def test_multi_logger_isolates_failing_backend(tmp_path, capsys):
+    # One raising backend must not kill the others — it is disabled with
+    # a one-time warning and the remaining backends keep logging.
+    bad = _ExplodingLogger()
+    buf = io.StringIO()
+    good = PrintLogger(stream=buf)
+    lg = MultiLogger(bad, good)
+    lg({"a": 1})
+    lg({"a": 2})
+    lg.close()
+    assert bad.writes == 1  # disabled after the first failure
+    assert "a=1" in buf.getvalue() and "a=2" in buf.getvalue()
+    err = capsys.readouterr().err
+    assert err.count("disabling _ExplodingLogger") == 1
+
+
+def test_multi_logger_close_isolates_failures():
+    class _BadClose(NullLogger):
+        def close(self):
+            raise RuntimeError("boom")
+
+    closed = []
+
+    class _Tracks(NullLogger):
+        def close(self):
+            closed.append(True)
+
+    MultiLogger(_BadClose(), _Tracks()).close()
+    assert closed == [True]
 
 
 def test_rng_pack_unpack_roundtrip():

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Lint telemetry metric names across the codebase (ISSUE 2 satellite).
+
+Statically scans `torched_impala_tpu/**/*.py` (and `bench.py`) for
+telemetry registration call sites — `.counter("...")`, `.gauge("...")`,
+`.timer("...")`, `.histogram("...")`, `.span("...")` — and for literal
+emitted keys (`"telemetry/..."` strings and `f"{PREFIX}/..."`
+interpolations), then asserts:
+
+1. every registered name matches the `<component>/<name>` slug grammar
+   (so every emitted key matches `telemetry/<component>/<name>[_suffix]`);
+2. no two call sites register the same name with DIFFERENT metric types
+   (a `span` counts as its backing `timer`) — a type fork would silently
+   split one series into two;
+3. every literal emitted key carries the `telemetry/` prefix and the same
+   grammar.
+
+Static on purpose: the lint runs from the test suite
+(tests/test_telemetry.py) on every CI pass without spawning pools or
+initializing jax, and it sees DEAD call sites too (a name typo'd in a
+rarely-taken branch still fails). The registry enforces the same two
+rules at runtime as a backstop for dynamically-built names, which this
+scan cannot see.
+
+Exit code: 0 clean, 1 with findings (one per line on stderr).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# .counter("pool/restarts") / reg.span('learner/train_step') ...
+_REG_CALL = re.compile(
+    r"\.(counter|gauge|timer|histogram|span)\(\s*([\"'])([^\"']+)\2"
+)
+# Literal emitted keys: a quoted string that IS a key ("telemetry/...",
+# nothing else inside the quotes — prose mentioning keys is skipped) or
+# an f"{PREFIX}/..." interpolation.
+_LITERAL_KEY = re.compile(r"[\"']telemetry/([a-z0-9_/]+)[\"']")
+_PREFIX_KEY = re.compile(r"\{PREFIX\}/([a-z0-9_/]+)")
+
+# <component>/<name> for registrations; emitted keys additionally allow
+# the suffixes snapshot_into appends (_ms, _p95, ... — same charset).
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
+
+# span() is sugar over timer() — the two share a series by design.
+_CANONICAL = {"span": "timer"}
+
+
+def _py_files(root: str) -> List[str]:
+    files = [os.path.join(root, "bench.py")]
+    pkg = os.path.join(root, "torched_impala_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        files.extend(
+            os.path.join(dirpath, f)
+            for f in filenames
+            if f.endswith(".py")
+        )
+    return [f for f in files if os.path.exists(f)]
+
+
+def check(root: str = REPO) -> List[str]:
+    """Return a list of human-readable findings (empty = clean)."""
+    errors: List[str] = []
+    # name -> (canonical kind, first site)
+    seen: Dict[str, Tuple[str, str]] = {}
+    for path in sorted(_py_files(root)):
+        rel = os.path.relpath(path, root)
+        if rel == os.path.join("torched_impala_tpu", "telemetry",
+                               "registry.py"):
+            # The registry itself only defines the machinery; its
+            # docstring examples would read as registrations.
+            continue
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                site = f"{rel}:{lineno}"
+                for kind, _q, name in _REG_CALL.findall(line):
+                    kind = _CANONICAL.get(kind, kind)
+                    if not NAME_RE.match(name):
+                        errors.append(
+                            f"{site}: {kind} name {name!r} does not "
+                            f"match <component>/<name> "
+                            f"({NAME_RE.pattern})"
+                        )
+                        continue
+                    prev = seen.get(name)
+                    if prev is None:
+                        seen[name] = (kind, site)
+                    elif prev[0] != kind:
+                        errors.append(
+                            f"{site}: {name!r} registered as {kind} "
+                            f"but {prev[1]} registered it as {prev[0]}"
+                        )
+                for m in _LITERAL_KEY.finditer(line):
+                    if not NAME_RE.match(m.group(1)):
+                        errors.append(
+                            f"{site}: literal key "
+                            f"'telemetry/{m.group(1)}' does not match "
+                            f"telemetry/<component>/<name>"
+                        )
+                for m in _PREFIX_KEY.finditer(line):
+                    if not NAME_RE.match(m.group(1)):
+                        errors.append(
+                            f"{site}: emitted key '{{PREFIX}}/"
+                            f"{m.group(1)}' does not match "
+                            f"telemetry/<component>/<name>"
+                        )
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(e, file=sys.stderr)
+    n = len(errors)
+    print(
+        f"check_metric_names: {'FAIL' if n else 'OK'} "
+        f"({n} finding{'s' if n != 1 else ''})",
+        file=sys.stderr,
+    )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

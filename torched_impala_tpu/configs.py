@@ -134,6 +134,14 @@ class ExperimentConfig:
     discount: float = 0.99
     entropy_coef: float = 0.01
     vf_coef: float = 0.5
+    # Observability (telemetry/, docs/OBSERVABILITY.md): merge the
+    # telemetry registry snapshot into every Nth metrics write (0 = keep
+    # recording but never merge), and arm the stall watchdog with this
+    # deadline in seconds (0 = off). 300s is comfortably above any sane
+    # step/wave period on every preset yet turns an overnight silent hang
+    # into a same-minute stack dump.
+    telemetry_interval: int = 1
+    stall_timeout_s: float = 300.0
     # Parallelism: shard the learner batch over this many devices (DP);
     # 0 = single device. SURVEY.md §3b DP row.
     dp_devices: int = 0

@@ -129,6 +129,42 @@ def _check_env_contract(name: str) -> tuple[str, str]:
             pass
 
 
+def _check_telemetry() -> tuple[str, str]:
+    """Exercise the telemetry stack in-process: one metric of each kind
+    through a fresh registry, snapshot key-grammar validation, and the
+    jax.profiler capture surface (`--profile-steps` / SIGUSR1 depend on
+    it). Purely local — no threads, pools, or devices."""
+    import re
+
+    try:
+        import jax
+
+        from torched_impala_tpu.telemetry import Registry
+
+        reg = Registry()
+        reg.counter("doctor/count").inc(3)
+        reg.gauge("doctor/gauge").set(1.5)
+        with reg.span("doctor/span"):
+            pass
+        reg.histogram("doctor/hist_ms").observe(2.0)
+        reg.heartbeat("doctor")
+        snap = reg.snapshot()
+        assert snap["telemetry/doctor/count"] == 3, snap
+        assert snap["telemetry/doctor/hist_ms_count"] == 1, snap
+        key_re = re.compile(r"^telemetry/[a-z0-9_]+/[a-z0-9_]+$")
+        bad = [k for k in snap if not key_re.match(k)]
+        assert not bad, f"malformed snapshot keys: {bad}"
+        profiler_ok = hasattr(jax.profiler, "start_trace") and hasattr(
+            jax.profiler, "stop_trace"
+        )
+        return "ok", (
+            f"registry roundtrip ({len(snap)} keys), profiler "
+            f"{'ok' if profiler_ok else 'MISSING start/stop_trace'}"
+        )
+    except Exception:
+        return "FAIL", f"telemetry stack broken:\n{traceback.format_exc()}"
+
+
 def _train_probe(config_name: str) -> tuple[str, str]:
     """Two real learner steps through the full runtime on the preset's
     REAL envs (no fakes) — the end-to-end first-contact check."""
@@ -219,7 +255,9 @@ def run_doctor(config_name: str | None = None) -> int:
         f"({time.perf_counter() - t0:.1f}s)"
     )
 
-    failed = False
+    status, detail = _check_telemetry()
+    print(f"  telemetry  [{status}] {detail}")
+    failed = status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)
         print(f"  env {family:10s} [{status}] {detail}")

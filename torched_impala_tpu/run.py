@@ -142,7 +142,29 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "serial protocol — see runtime/evaluator.py)")
     # Profiling (SURVEY.md §6 tracing row).
     p.add_argument("--profile-dir", default=None,
-                   help="capture a jax.profiler trace of the learner loop")
+                   help="capture a jax.profiler trace of the WHOLE learner "
+                        "loop (includes compile time; for a bounded "
+                        "steady-state window use --profile-steps)")
+    p.add_argument("--profile-steps", default=None, metavar="A:B",
+                   help="capture a jax.profiler trace window: open after "
+                        "learner step A completes, close after step B, "
+                        "written under --trace-dir (telemetry/profiling.py)")
+    p.add_argument("--trace-dir", default="traces",
+                   help="directory for --profile-steps / SIGUSR1 trace "
+                        "captures (one subdirectory per capture)")
+    # Observability (telemetry/, docs/OBSERVABILITY.md). SIGUSR1 on a
+    # live train run toggles a profiler capture into --trace-dir.
+    p.add_argument("--telemetry-every", type=int, default=None,
+                   help="merge the telemetry registry snapshot "
+                        "(telemetry/<component>/<name> keys) into every "
+                        "Nth metrics write (default: preset's "
+                        "telemetry_interval, normally 1; 0 disables)")
+    p.add_argument("--stall-timeout", type=float, default=None,
+                   help="stall watchdog deadline in seconds: no learner "
+                        "step or actor wave for this long dumps all "
+                        "thread stacks + telemetry to stderr and emits a "
+                        "telemetry/watchdog/stall event (default: "
+                        "preset's stall_timeout_s, normally 300; 0 off)")
     return p.parse_args(argv)
 
 
@@ -194,6 +216,29 @@ def build_config(args: argparse.Namespace):
             )
             cfg = dataclasses.replace(cfg, num_actions=real)
     return cfg
+
+
+def make_profiler(args: argparse.Namespace):
+    """(capture, window) for on-demand jax.profiler traces: SIGUSR1 on
+    the live process toggles a capture into --trace-dir (best-effort
+    install), and --profile-steps A:B drives a bounded learner-step
+    window. `window` is None without --profile-steps."""
+    from torched_impala_tpu.telemetry import (
+        ProfilerCapture,
+        StepWindowProfiler,
+        parse_profile_steps,
+    )
+
+    capture = ProfilerCapture(args.trace_dir)
+    capture.install_sigusr1()
+    window = None
+    if args.profile_steps:
+        try:
+            start, stop = parse_profile_steps(args.profile_steps)
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
+        window = StepWindowProfiler(capture, start, stop)
+    return capture, window
 
 
 def make_logger(args: argparse.Namespace):
@@ -371,6 +416,7 @@ def main(argv=None) -> int:
         file=sys.stderr,
     )
 
+    capture, profile_window = make_profiler(args)
     profile_ctx = None
     if args.profile_dir:
         profile_ctx = jax.profiler.trace(
@@ -398,8 +444,24 @@ def main(argv=None) -> int:
             actor_mode=cfg.actor_mode,
             pool_mode=cfg.pool_mode,
             pool_ready_fraction=cfg.pool_ready_fraction,
+            telemetry_interval=(
+                args.telemetry_every
+                if args.telemetry_every is not None
+                else cfg.telemetry_interval
+            ),
+            stall_timeout=(
+                args.stall_timeout
+                if args.stall_timeout is not None
+                else cfg.stall_timeout_s
+            ),
+            on_learner_step=(
+                profile_window.on_step if profile_window else None
+            ),
         )
     finally:
+        if profile_window is not None:
+            profile_window.close()  # flush a still-open step window
+        capture.stop()  # flush a SIGUSR1 capture left running
         if profile_ctx is not None:
             profile_ctx.__exit__(*sys.exc_info())
         logger.close()
@@ -479,6 +541,7 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
         )
     remaining = remaining_updates // N
 
+    capture, profile_window = make_profiler(args)
     profile_ctx = None
     if args.profile_dir:
         profile_ctx = jax.profiler.trace(
@@ -494,8 +557,14 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
         def crossed(interval: int) -> bool:
             return crossed_interval(runner.num_steps, N, interval)
 
+        if profile_window is not None:
+            # Same contract as the actor runtime: a window whose start is
+            # already behind the restored step opens on the first step.
+            profile_window.on_step(runner.num_steps)
         for _ in range(remaining):
             logs = runner.step()
+            if profile_window is not None:
+                profile_window.on_step(runner.num_steps)
             if args.log_every and crossed(args.log_every):
                 host_logs = {k: float(v) for k, v in logs.items()}
                 host_logs["num_steps"] = runner.num_steps
@@ -508,6 +577,9 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
             ):
                 checkpointer.save(runner.num_steps, runner.get_state())
     finally:
+        if profile_window is not None:
+            profile_window.close()
+        capture.stop()
         if profile_ctx is not None:
             profile_ctx.__exit__(*sys.exc_info())
         if checkpointer is not None:

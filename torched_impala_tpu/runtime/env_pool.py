@@ -69,11 +69,17 @@ from __future__ import annotations
 import multiprocessing as mp
 import pickle
 import time
+import weakref
 from multiprocessing import connection as mp_connection
 from multiprocessing import shared_memory
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+# Parent-side only: workers never record telemetry (their latency is
+# measured from the parent's submit->ack edge, so worker processes stay
+# numpy-only and never share metric locks across the fork).
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
 
 try:
     _CTX = mp.get_context("forkserver")
@@ -246,6 +252,7 @@ class ProcessEnvPool:
         step_timeout: float = 300.0,
         mode: str = "lockstep",
         ready_fraction: float = 0.5,
+        telemetry: Optional[Registry] = None,
     ) -> None:
         if num_workers < 1 or envs_per_worker < 1:
             raise ValueError("need >= 1 worker and >= 1 env per worker")
@@ -278,6 +285,31 @@ class ProcessEnvPool:
         self.mode = mode
         self.ready_fraction = ready_fraction
         self.restarts = 0
+
+        # Telemetry (docs/OBSERVABILITY.md "pool" rows). Worker step
+        # latency is the parent-observed submit->ack edge: it includes
+        # pipe turnaround, which is exactly the latency the inference
+        # wave experiences. A step slower than 2x the pool's EWMA counts
+        # as a straggler (the same normal-step filter the actor's grace
+        # window uses, vector_actor.advance).
+        reg = telemetry if telemetry is not None else get_registry()
+        self._m_step_ms = reg.histogram("pool/worker_step_ms")
+        self._m_restarts = reg.counter("pool/restarts")
+        self._m_stragglers = reg.counter("pool/stragglers")
+        # Shm-lane occupancy: fraction of workers with an unacked step in
+        # flight, read lazily at snapshot time. Weakref so the global
+        # registry never keeps a closed pool alive.
+        pool_ref = weakref.ref(self)
+
+        def _occupancy() -> float:
+            pool = pool_ref()
+            if pool is None:
+                return float("nan")
+            return len(pool._in_flight) / pool._num_workers
+
+        reg.gauge("pool/lane_occupancy", fn=_occupancy)
+        self._submit_t = [0.0] * num_workers
+        self._step_ewma: Optional[float] = None
 
         n = num_workers * envs_per_worker
         obs_bytes = n * int(np.prod(self._obs_shape)) * self._obs_dtype.itemsize
@@ -379,14 +411,44 @@ class ProcessEnvPool:
             )
         return conn.recv()
 
+    # A step only counts as a straggler above BOTH 2x the pool's EWMA and
+    # this absolute floor: relative-only flagging drowns the counter in
+    # scheduler micro-jitter when normal steps are sub-millisecond
+    # (observed ~10% false positives on 0.3ms fake-env steps), while real
+    # emulator stalls — GC pauses, level loads — sit well above 5ms.
+    STRAGGLER_FLOOR_S = 5e-3
+
+    def _observe_step(self, w: int) -> None:
+        """Record worker `w`'s submit->ack latency into the step
+        histogram, and count it as a straggler when it exceeds 2x the
+        pool's EWMA of NORMAL steps (stalls are excluded from the EWMA so
+        a burst of stragglers can't redefine normal) AND the absolute
+        floor above."""
+        t0 = self._submit_t[w]
+        if t0 <= 0.0:
+            return
+        self._submit_t[w] = 0.0
+        dur = time.monotonic() - t0
+        self._m_step_ms.observe(dur * 1e3)
+        ewma = self._step_ewma
+        if ewma is None:
+            self._step_ewma = dur
+        elif dur >= 2.0 * ewma:
+            if dur >= self.STRAGGLER_FLOOR_S:
+                self._m_stragglers.inc()
+        else:
+            self._step_ewma = 0.8 * ewma + 0.2 * dur
+
     def _restart(self, w: int, reason: str) -> None:
         self._in_flight.discard(w)  # a fresh worker has nothing in flight
+        self._submit_t[w] = 0.0  # no ack will come for the dead step
         if self.restarts >= self._max_restarts:
             raise RuntimeError(
                 f"env worker {w} died ({reason}) and the pool restart "
                 f"budget ({self._max_restarts}) is spent"
             )
         self.restarts += 1
+        self._m_restarts.inc()
         proc = self._procs[w]
         if proc is not None and proc.is_alive():
             proc.terminate()
@@ -464,6 +526,7 @@ class ProcessEnvPool:
         dead: List[int] = []
         for w in range(self._num_workers):
             try:
+                self._submit_t[w] = time.monotonic()
                 self._conns[w].send(("step",))
             except (BrokenPipeError, OSError) as e:
                 self._restart(w, f"send failed: {e!r}")
@@ -476,6 +539,12 @@ class ProcessEnvPool:
                 continue
             try:
                 msg = self._recv(w)
+                # Lockstep latency is recv-order-serialized: a fast
+                # worker behind a slow recv reads as slow. The histogram
+                # still captures the wave-gating distribution (what the
+                # actor actually waits on); async mode gives the true
+                # per-worker numbers.
+                self._observe_step(w)
                 if msg[0] == "error":
                     raise RuntimeError(f"env worker {w}: {msg[1]}")
                 assert msg[0] == "stepped", msg
@@ -508,6 +577,7 @@ class ProcessEnvPool:
         sl = self._worker_slice(w)
         self._act_lane[sl] = np.asarray(actions, np.int32)
         try:
+            self._submit_t[w] = time.monotonic()
             self._conns[w].send(("step",))
         except (BrokenPipeError, OSError) as e:
             self._restart(w, f"send failed: {e!r}")
@@ -565,6 +635,7 @@ class ProcessEnvPool:
             try:
                 msg = conn.recv()
                 self._in_flight.discard(w)
+                self._observe_step(w)
                 if msg[0] == "error":
                     raise RuntimeError(f"env worker {w}: {msg[1]}")
                 assert msg[0] == "stepped", msg

@@ -22,6 +22,7 @@ import dataclasses
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Mapping, Optional
 
 import jax
@@ -48,6 +49,7 @@ from torched_impala_tpu.parallel.mesh import (
 )
 from torched_impala_tpu.parallel import multihost
 from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.telemetry.registry import get_registry
 from torched_impala_tpu.runtime.types import (
     QueueClosed,
     Trajectory,
@@ -450,6 +452,35 @@ class Learner:
         self._wait_accum = 0.0
         self._last_log_t: Optional[float] = None
         self._last_log_frames = 0
+        self._last_log_steps = 0
+
+        # Registry telemetry (docs/OBSERVABILITY.md "learner"/"queue"
+        # rows): the four stage spans decompose one learner step into
+        # host stacking, H2D dispatch, the XLA step, and param publish —
+        # together with queue depth / batch wait they localize the
+        # pipeline bottleneck. Resolved once; spans cost two monotonic()
+        # reads + one lock on a many-ms stage.
+        reg = get_registry()
+        self._telemetry = reg
+        self._m_host_stack = reg.timer("learner/host_stack")
+        self._m_device_put = reg.timer("learner/device_put")
+        self._m_train_step = reg.timer("learner/train_step")
+        self._m_publish = reg.timer("learner/publish")
+        self._m_batch_wait = reg.timer("learner/batch_wait")
+        self._m_steps_per_sec = reg.gauge("learner/steps_per_sec")
+        self._m_param_lag = reg.gauge("learner/param_lag_frames")
+        self._m_enqueue_block = reg.histogram("queue/enqueue_block_ms")
+        reg.gauge("queue/capacity").set(capacity)
+        # Live depth, read lazily at snapshot time. Weakref: the global
+        # registry must not keep a dead learner's queue (and its queued
+        # trajectory arrays) alive.
+        q_ref = weakref.ref(self._traj_q)
+
+        def _depth() -> float:
+            q = q_ref()
+            return float("nan") if q is None else q.qsize()
+
+        reg.gauge("queue/depth", fn=_depth)
 
         self.param_store = ParamStore()
         self._publish()
@@ -764,11 +795,18 @@ class Learner:
     def enqueue(self, traj: Trajectory) -> None:
         """Called by actors; blocks when the learner is behind (backpressure).
         Raises QueueClosed after `stop()` so blocked actors can exit."""
+        t0 = time.monotonic()
         while True:
             if self._stop.is_set():
                 raise QueueClosed()
             try:
                 self._traj_q.put(traj, timeout=0.5)
+                # Time spent blocked on a full queue: ~0 means the learner
+                # keeps up; growing p95 means actors outrun it (the
+                # backpressure diagnostic, ISSUE 2 queue row).
+                self._m_enqueue_block.observe(
+                    (time.monotonic() - t0) * 1e3
+                )
                 return
             except queue.Full:
                 continue
@@ -962,7 +1000,8 @@ class Learner:
         trajs = self._collect_trajs()
         if trajs is None:
             return None
-        return stack_trajectories(trajs, out=self._stack_out(trajs))
+        with self._m_host_stack.time():
+            return stack_trajectories(trajs, out=self._stack_out(trajs))
 
     def _assemble_superbatch(self, K: int) -> Optional[Trajectory]:
         """`[K, ...]` superbatch, each slice stacked in place so every
@@ -991,9 +1030,10 @@ class Learner:
                 param_version=0,
                 task=sb.task[k],
             )
-            versions.append(
-                stack_trajectories(trajs, out=view).param_version
-            )
+            with self._m_host_stack.time():
+                versions.append(
+                    stack_trajectories(trajs, out=view).param_version
+                )
         return sb._replace(param_version=min(versions))
 
     def _batcher_loop_impl(self) -> None:
@@ -1027,6 +1067,12 @@ class Learner:
                 batch.task,
                 batch.agent_state,
             )
+            # Span covers the host-side DISPATCH of the H2D transfer
+            # (jax's copy itself may complete asynchronously — the
+            # double-buffering design point); a growing value here still
+            # flags the feed path, which is what the breakdown is for.
+            put_span = self._m_device_put.time()
+            put_span.__enter__()
             if self._data_device is not None:
                 on_device = jax.device_put(arrays, self._data_device)
             elif self._mesh is None:
@@ -1053,6 +1099,7 @@ class Learner:
                 on_device = multihost.place_batch(
                     self._batch_shardings, arrays
                 )
+            put_span.__exit__()
             self._record_pending_transfer(on_device)
             while True:
                 if self._stop.is_set():
@@ -1078,19 +1125,21 @@ class Learner:
     # ---- stepping ------------------------------------------------------
 
     def _publish(self) -> None:
-        # Kick off all leaf D2H copies before materializing any: np.asarray
-        # alone would serialize one synchronous transfer per leaf (each a
-        # full round trip on a tunnelled device).
-        for leaf in jax.tree.leaves(self._params):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+        with self._m_publish.time():
+            # Kick off all leaf D2H copies before materializing any:
+            # np.asarray alone would serialize one synchronous transfer
+            # per leaf (each a full round trip on a tunnelled device).
+            for leaf in jax.tree.leaves(self._params):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
 
-        # host_snapshot, not bare np.asarray: the train step DONATES the
-        # param buffers, so a zero-copy view here would let actors' params
-        # silently morph when XLA reuses the memory (see types.host_snapshot).
-        self.param_store.publish(
-            self.num_frames, host_snapshot(self._params)
-        )
+            # host_snapshot, not bare np.asarray: the train step DONATES
+            # the param buffers, so a zero-copy view here would let
+            # actors' params silently morph when XLA reuses the memory
+            # (see types.host_snapshot).
+            self.param_store.publish(
+                self.num_frames, host_snapshot(self._params)
+            )
 
     def step_once(self, timeout: Optional[float] = None) -> Mapping[str, Any]:
         """Block for one device batch, take one SGD step, publish params.
@@ -1108,7 +1157,10 @@ class Learner:
             # Count timed-out waits too (queue.Empty propagates to the run
             # loop): starvation time must not vanish from the diagnostic
             # exactly when starvation is worst.
-            self._wait_accum += time.monotonic() - t0
+            wait = time.monotonic() - t0
+            self._wait_accum += wait
+            self._m_batch_wait.observe(wait)
+        step_t0 = time.monotonic()
         step = (
             self._auto_compiled
             if self._auto_compiled is not None
@@ -1177,10 +1229,17 @@ class Learner:
                     *arrays,
                 )
             )
+        # Host-observed dispatch+compute time of the XLA step. On an
+        # async-dispatch backend the tail of the compute may overlap the
+        # next host iteration; the steady-state EWMA still tracks the
+        # device step (the pipeline re-synchronizes on the batch queue).
+        self._m_train_step.observe(time.monotonic() - step_t0)
         T = self._config.unroll_length
         K = self._config.steps_per_dispatch
         self.num_frames += T * self._config.batch_size * K
         self.num_steps += K
+        self._m_param_lag.set(self.num_frames - batch_version)
+        self._telemetry.heartbeat("learner")
         logs = dict(logs)
         logs["num_frames"] = self.num_frames
         logs["num_steps"] = self.num_steps
@@ -1204,6 +1263,9 @@ class Learner:
                 logs["batch_wait_frac"] = min(
                     self._wait_accum / elapsed, 1.0
                 )
+                self._m_steps_per_sec.set(
+                    (self.num_steps - self._last_log_steps) / elapsed
+                )
             else:
                 # Keys must exist on the first write too (CSV columns are
                 # fixed by the first row).
@@ -1211,6 +1273,7 @@ class Learner:
                 logs["batch_wait_frac"] = float("nan")
             self._last_log_t = now
             self._last_log_frames = self.num_frames
+            self._last_log_steps = self.num_steps
             self._wait_accum = 0.0
             self._logger(
                 {

@@ -23,6 +23,7 @@ from torched_impala_tpu.runtime.actor import Actor
 from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
 from torched_impala_tpu.runtime.supervisor import ActorSupervisor
 from torched_impala_tpu.runtime.vector_actor import VectorActor
+from torched_impala_tpu.telemetry import StallWatchdog, get_registry
 
 
 @dataclasses.dataclass
@@ -56,6 +57,9 @@ def train(
     actor_mode: str = "thread",
     pool_mode: str = "lockstep",
     pool_ready_fraction: float = 0.5,
+    telemetry_interval: int = 1,
+    stall_timeout: float = 0.0,
+    on_learner_step: Optional[Callable[[int], None]] = None,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -90,6 +94,19 @@ def train(
     - "async": ready-set batching — inference runs over whichever
       `pool_ready_fraction` of workers has reported, stragglers catch up
       on the next wave (runtime/env_pool.py async protocol).
+
+    Observability (docs/OBSERVABILITY.md):
+    - `telemetry_interval=N` merges the global telemetry registry's
+      snapshot (`telemetry/actor|pool|queue|learner/*` keys) into every
+      Nth logger write; 0 disables the merge (registry still records).
+    - `stall_timeout=S` (seconds, 0 = off) arms a stall watchdog: if no
+      learner step or actor wave completes for S seconds it dumps every
+      thread's stack + the registry snapshot to stderr and emits a
+      `telemetry/watchdog/stall` event through the logger, instead of
+      letting a wedged run hang silently.
+    - `on_learner_step(num_steps)` is called after every learner step
+      (and once at startup with the restored step count) — run.py's
+      `--profile-steps` window hooks in here.
     """
     if actor_mode not in ("thread", "process"):
         raise ValueError(f"unknown actor_mode {actor_mode!r}")
@@ -118,11 +135,17 @@ def train(
     # queue); the logger callback may fire before then (e.g. on resume), so
     # guard the reference instead of closing over an unbound name.
     supervisor: Optional[ActorSupervisor] = None
+    registry = get_registry()
+    # Two writers may now reach `logger`: the learner's log stream (below)
+    # and the stall watchdog's event (a stalled run has no learner writes,
+    # so the event cannot ride that stream). Loggers are not assumed
+    # thread-safe, so both writers serialize on this lock.
+    logger_lock = threading.Lock()
+    telemetry_writes = [0]
 
     def learner_logger(logs: Mapping[str, Any]) -> None:
         # Called by the learner every `log_interval` steps with host floats.
-        # The ONLY writer to `logger`: loggers are not assumed thread-safe,
-        # and schema-dependent ones (CSV) need a stable key set, so restart
+        # Schema-dependent loggers (CSV) need a stable key set, so restart
         # telemetry rides this stream instead of the monitor thread's.
         step_logs.update(logs)
         if logger is not None:
@@ -135,7 +158,15 @@ def train(
             merged["actor_restarts"] = (
                 supervisor.restarts if supervisor is not None else 0
             )
-            logger(merged)
+            if telemetry_interval > 0:
+                telemetry_writes[0] += 1
+                if telemetry_writes[0] % telemetry_interval == 0:
+                    # The registry snapshot rides the existing write(dict)
+                    # surface: every logger backend gets the namespaced
+                    # telemetry/<component>/<name> series for free.
+                    merged.update(registry.snapshot())
+            with logger_lock:
+                logger(merged)
 
     learner = Learner(
         agent=agent,
@@ -151,6 +182,7 @@ def train(
         if restored is not None:
             learner.set_state(restored)
 
+    post_hooks: list = []
     if checkpointer is not None and checkpoint_interval > 0:
         last_saved = [learner.num_steps]
 
@@ -161,7 +193,20 @@ def train(
                 checkpointer.save(num_steps, learner.get_state())
                 last_saved[0] = num_steps
 
-        learner.post_step = _checkpoint_hook
+        post_hooks.append(_checkpoint_hook)
+    if on_learner_step is not None:
+        post_hooks.append(on_learner_step)
+        # Fire once with the CURRENT (possibly restored) step count so a
+        # profile window whose start step is already behind us opens at
+        # the run's first step instead of never.
+        on_learner_step(learner.num_steps)
+    if post_hooks:
+
+        def _post_step(num_steps: int) -> None:
+            for hook in post_hooks:
+                hook(num_steps)
+
+        learner.post_step = _post_step
 
     # `total_steps` is the TOTAL step budget: a resumed run does only the
     # remainder, so the optax schedule and the frame budget line up.
@@ -300,9 +345,26 @@ def train(
                 f"({supervisor.restarts} restarts performed); {detail}"
             )
 
+    stall_watchdog: Optional[StallWatchdog] = None
+    if stall_timeout > 0:
+
+        def _on_stall(event: Mapping[str, Any]) -> None:
+            # The stack dump already went to stderr (watchdog thread);
+            # this pushes the machine-readable event into the metrics
+            # stream so dashboards/log scrapers see the stall too.
+            if logger is not None:
+                with logger_lock:
+                    logger(dict(event))
+
+        stall_watchdog = StallWatchdog(
+            registry, deadline_s=stall_timeout, on_stall=_on_stall
+        ).start()
+
     try:
         learner.run(remaining_steps, stop_event, watchdog=watchdog)
     finally:
+        if stall_watchdog is not None:
+            stall_watchdog.stop()
         stop_event.set()
         learner.stop()
         # Drain the trajectory queue so actor threads blocked on a full

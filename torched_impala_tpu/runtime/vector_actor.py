@@ -47,6 +47,7 @@ from torched_impala_tpu.runtime.types import (
     Trajectory,
     host_snapshot,
 )
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
 
 
 @functools.lru_cache(maxsize=None)
@@ -83,6 +84,7 @@ class VectorActor:
         on_episode_return: Optional[Callable[[int, float, int], None]] = None,
         device: Optional[jax.Device] = None,
         tasks: Optional[Sequence[int]] = None,
+        telemetry: Optional[Registry] = None,
     ) -> None:
         """`tasks` overrides the per-env task ids (default: each env's
         `task_id` attribute, else 0). `device` pins policy inference — see
@@ -106,6 +108,21 @@ class VectorActor:
             self._key = jax.device_put(self._key, device)
         self.error: Optional[BaseException] = None
         self.num_unrolls = 0  # counts emitted trajectories (E per cycle)
+
+        # Telemetry (docs/OBSERVABILITY.md "actor" rows): wave latency is
+        # one inference wave end-to-end (gather rows -> policy dispatch ->
+        # actions written back / envs stepped); the heartbeat after every
+        # wave feeds the stall watchdog. Metric objects are resolved ONCE
+        # here so the wave loop never does a name lookup.
+        reg = telemetry if telemetry is not None else get_registry()
+        self._telemetry = reg
+        self._m_wave_ms = reg.histogram("actor/wave_latency_ms")
+        self._m_waves = reg.counter("actor/waves")
+        self._m_unrolls = reg.counter("actor/unrolls")
+        self._m_wave_size = reg.gauge("actor/wave_size")
+        self._m_ready_frac = reg.gauge("actor/ready_fraction_achieved")
+        self._m_grace_ms = reg.gauge("actor/grace_window_ms")
+        self._m_unroll = reg.timer("actor/unroll")
 
         if hasattr(envs, "step_all"):  # batched env (ProcessEnvPool)
             self._pool = envs
@@ -152,6 +169,17 @@ class VectorActor:
             self._envs
         )
 
+    def _record_wave(
+        self, t0: float, rows: int, ready_frac: float
+    ) -> None:
+        """One inference wave completed: latency histogram, wave-shape
+        gauges, and the liveness heartbeat the stall watchdog reads."""
+        self._m_wave_ms.observe((time.monotonic() - t0) * 1e3)
+        self._m_waves.inc()
+        self._m_wave_size.set(rows)
+        self._m_ready_frac.set(ready_frac)
+        self._telemetry.heartbeat("actor")
+
     def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
         """Step all E envs for T steps; return E single-env trajectories."""
         if self._pool_async:
@@ -172,6 +200,7 @@ class VectorActor:
         start_state = host_snapshot(self._state)
 
         for t in range(T):
+            wave_t0 = time.monotonic()
             obs_buf[t] = self._obs
             first_buf[t] = self._first
             # Pass obs/first as host numpy: jit placement then follows the
@@ -209,6 +238,7 @@ class VectorActor:
                 if self._on_episode_return is not None:
                     for _, ret, length in events:
                         self._on_episode_return(self._id, ret, length)
+                self._record_wave(wave_t0, E, 1.0)
                 continue
 
             # The host-side env loop: the only per-env Python work left.
@@ -236,6 +266,7 @@ class VectorActor:
                     next_obs, _ = env.reset()
                 self._obs[i] = np.asarray(next_obs)
                 self._first[i] = done
+            self._record_wave(wave_t0, E, 1.0)
 
         obs_buf[T] = self._obs
         first_buf[T] = self._first
@@ -368,11 +399,15 @@ class VectorActor:
             # Full wave when EVERY remaining worker is ready (one extra
             # compiled shape); otherwise exactly wave_k so the jitted step
             # sees a bounded shape set while stragglers catch up.
+            ready_now = len(actionable)
             take = (
-                len(actionable)
-                if len(actionable) == remaining
-                else min(wave_k, len(actionable))
+                ready_now
+                if ready_now == remaining
+                else min(wave_k, ready_now)
             )
+            wave_t0 = time.monotonic()
+            if ewma_step is not None:
+                self._m_grace_ms.set(0.25 * ewma_step * 1e3)
             wave = [actionable.popleft() for _ in range(take)]
             rows = np.concatenate([np.arange(w * Ew, (w + 1) * Ew)
                                    for w in wave])
@@ -412,6 +447,11 @@ class VectorActor:
                         [],
                         timed=False,
                     )
+            # ready_fraction_achieved: how much of the still-running pool
+            # this wave actually served (1.0 = coalesced full batch — the
+            # grace window doing its job; ~ready_fraction = partial waves
+            # with stragglers catching up elsewhere).
+            self._record_wave(wave_t0, len(rows), take / remaining)
 
         return [
             Trajectory(
@@ -433,9 +473,12 @@ class VectorActor:
 
     def unroll_and_push(self) -> None:
         version, params = self._param_store.get()
-        for traj in self.unroll(params, version):
+        with self._m_unroll.time():
+            trajs = self.unroll(params, version)
+        for traj in trajs:
             self._enqueue(traj)
             self.num_unrolls += 1
+            self._m_unrolls.inc()
 
     def run(
         self,

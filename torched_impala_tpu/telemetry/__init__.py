@@ -1,0 +1,46 @@
+"""Telemetry subsystem: metrics registry, stall watchdog, profiler capture.
+
+See `registry.py` for the metric model, `watchdog.py` for stall
+detection, `profiling.py` for on-demand `jax.profiler` windows, and
+docs/OBSERVABILITY.md for the gauge -> pipeline-stage map.
+"""
+
+from torched_impala_tpu.telemetry.registry import (
+    DEFAULT_MS_BUCKETS,
+    NAME_RE,
+    PREFIX,
+    Counter,
+    EwmaTimer,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    set_enabled,
+)
+from torched_impala_tpu.telemetry.watchdog import (
+    StallWatchdog,
+    dump_thread_stacks,
+)
+from torched_impala_tpu.telemetry.profiling import (
+    ProfilerCapture,
+    StepWindowProfiler,
+    parse_profile_steps,
+)
+
+__all__ = [
+    "DEFAULT_MS_BUCKETS",
+    "NAME_RE",
+    "PREFIX",
+    "Counter",
+    "EwmaTimer",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "get_registry",
+    "set_enabled",
+    "StallWatchdog",
+    "dump_thread_stacks",
+    "ProfilerCapture",
+    "StepWindowProfiler",
+    "parse_profile_steps",
+]

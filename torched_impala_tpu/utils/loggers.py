@@ -19,7 +19,7 @@ import json
 import os
 import sys
 import time
-from typing import IO, Mapping, Optional, Sequence
+from typing import IO, Mapping, Optional
 
 
 def _step_of(metrics: Mapping[str, object], fallback: int) -> int:
@@ -72,29 +72,83 @@ class PrintLogger(Logger):
 
 
 class CSVLogger(Logger):
-    """Append rows to a CSV file; columns fixed by the first write (later
-    unseen keys are dropped — keep the learner's scalar set stable)."""
+    """Append rows to a CSV file, widening the header as new keys appear.
+
+    - Columns start from the FIRST write — or from the existing file's
+      header when the path already exists, so a resumed run APPENDS to
+      its history instead of clobbering it (parity with
+      `JSONLinesLogger`'s append mode).
+    - A write carrying unseen keys rewrites the file once with the
+      widened header (old rows get "" in the new columns; existing
+      columns never move — first-seen order), then appending resumes.
+      Telemetry series that register mid-run (ISSUE 2) therefore show up
+      as new columns instead of being silently dropped.
+    """
 
     def __init__(self, path: str):
         self._path = path
         self._file: Optional[IO[str]] = None
         self._writer: Optional[csv.DictWriter] = None
-        self._fields: Sequence[str] = ()
+        self._fields: list = []
+
+    def _make_writer(self, file: IO[str]) -> csv.DictWriter:
+        return csv.DictWriter(
+            file, fieldnames=self._fields, extrasaction="ignore"
+        )
+
+    def _open_append(self) -> None:
+        self._file = open(self._path, "a", newline="")
+        self._writer = self._make_writer(self._file)
+
+    def _existing_header(self) -> Optional[list]:
+        try:
+            with open(self._path, newline="") as f:
+                return next(csv.reader(f), None)
+        except FileNotFoundError:
+            return None
+
+    def _rewrite_widened(self, fields: list) -> None:
+        """Rewrite the whole file under a widened header (atomic
+        tmp+rename), preserving every existing row, then reopen for
+        append. Widenings are rare (new series registering), so the
+        O(file) rewrite is paid a handful of times per run."""
+        if self._file is not None:
+            self._file.close()
+        rows: list = []
+        try:
+            with open(self._path, newline="") as f:
+                rows = list(csv.DictReader(f))
+        except FileNotFoundError:
+            pass
+        self._fields = fields
+        tmp = self._path + ".tmp"
+        with open(tmp, "w", newline="") as f:
+            writer = self._make_writer(f)
+            writer.writeheader()
+            for row in rows:
+                writer.writerow({k: row.get(k, "") for k in fields})
+        os.replace(tmp, self._path)
+        self._open_append()
 
     def write(self, metrics: Mapping[str, object]) -> None:
         if self._writer is None:
-            self._fields = list(metrics.keys())
             os.makedirs(
                 os.path.dirname(os.path.abspath(self._path)), exist_ok=True
             )
-            self._file = open(self._path, "w", newline="")
-            self._writer = csv.DictWriter(
-                self._file, fieldnames=self._fields, extrasaction="ignore"
-            )
-            self._writer.writeheader()
-        row = {k: metrics.get(k, "") for k in self._fields}
-        self._writer.writerow(row)
-        assert self._file is not None
+            header = self._existing_header()
+            if header:
+                self._fields = list(header)
+                self._open_append()
+            else:
+                self._fields = list(metrics.keys())
+                self._file = open(self._path, "w", newline="")
+                self._writer = self._make_writer(self._file)
+                self._writer.writeheader()
+        new = [k for k in metrics.keys() if k not in self._fields]
+        if new:
+            self._rewrite_widened(self._fields + new)
+        assert self._writer is not None and self._file is not None
+        self._writer.writerow({k: metrics.get(k, "") for k in self._fields})
         self._file.flush()
 
     def close(self) -> None:
@@ -146,15 +200,41 @@ class TensorBoardLogger(Logger):
 
 
 class MultiLogger(Logger):
-    """Fan a write out to several loggers."""
+    """Fan a write out to several loggers, isolating failures: a backend
+    whose `write` raises (full disk, dead TensorBoard writer, ...) is
+    disabled with a one-time stderr warning instead of killing the
+    training run — the remaining backends keep logging."""
 
     def __init__(self, *loggers: Logger):
-        self._loggers = loggers
+        self._loggers = list(loggers)
+        self._disabled: set = set()
 
     def write(self, metrics: Mapping[str, object]) -> None:
-        for lg in self._loggers:
-            lg.write(metrics)
+        for i, lg in enumerate(self._loggers):
+            if i in self._disabled:
+                continue
+            try:
+                lg.write(metrics)
+            except Exception as e:  # noqa: BLE001 — isolate ANY backend fault
+                self._disabled.add(i)
+                print(
+                    f"[loggers] disabling {type(lg).__name__} after write "
+                    f"error: {e!r}; remaining backends keep logging",
+                    file=sys.stderr,
+                    flush=True,
+                )
 
     def close(self) -> None:
+        # Disabled backends are closed too: their earlier writes may be
+        # sitting in a buffer worth flushing. Close faults are warned,
+        # never propagated — one broken backend must not block the rest
+        # from closing.
         for lg in self._loggers:
-            lg.close()
+            try:
+                lg.close()
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"[loggers] {type(lg).__name__}.close() failed: {e!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
