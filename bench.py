@@ -280,6 +280,10 @@ def main(args) -> None:
     # Host-side: telemetry registry overhead on the env-pool hot path
     # (ISSUE 2 acceptance: < 2% of env-pool steps/s with telemetry on).
     section("telemetry", lambda: run_bench_telemetry(jax))
+    # Host-side: zero-copy trajectory ring vs the queue path (ISSUE 3
+    # acceptance: host_stack span + per-unroll enqueue copy bytes drop,
+    # batches bit-identical on fixed seeds).
+    section("traj_ring", lambda: run_bench_traj_ring(jax))
     section("e2e_components", lambda: run_e2e_components(jax))
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
@@ -1615,6 +1619,147 @@ def run_bench_telemetry(jax) -> dict:
     log(f"bench: telemetry overhead: {out['overhead_pct']}% "
         f"(on {out['env_steps_per_sec_on']} vs off "
         f"{out['env_steps_per_sec_off']} steps/s)")
+    return out
+
+
+def run_bench_traj_ring(jax, tiny: bool = False) -> dict:
+    """Zero-copy trajectory ring vs the queue path (ISSUE 3 tentpole):
+    one VectorActor over fake Pong envs (84x84x4 uint8) feeding the real
+    Learner batcher, fixed seeds, both data paths.
+
+    Claims under test (the ISSUE 3 acceptance bound; asserted by
+    tests/test_bench_units.py on the tiny variant):
+    - batches are BIT-IDENTICAL between the two paths (same envs, same
+      policy stream — the ring changes where bytes land, not what they
+      are);
+    - `telemetry/learner/host_stack_ms` drops (ring batches need no
+      np.stack — the batcher hands slot views straight to device_put);
+    - per-unroll enqueue copy bytes (`telemetry/learner/
+      host_stack_bytes`, the bytes the stacking path copies) drop to 0.
+
+    Honesty note: on backends where device_put can ALIAS host numpy (the
+    jax CPU backend — this rig's test/fallback path), the ring stages
+    each batch through ONE owning copy before transfer so slot recycling
+    can't corrupt in-flight batches; those bytes are reported separately
+    (`ring_stage_bytes_per_unroll`) and are 0 on copying-H2D production
+    backends (TPU). Even staged, the ring is one copy per unroll fewer
+    than the queue path (actor-private buffers + np.stack)."""
+    import numpy as np
+    import optax
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.models import Agent, AtariShallowTorso, ImpalaNet
+    from torched_impala_tpu.runtime import Learner, LearnerConfig, VectorActor
+    from torched_impala_tpu.telemetry import Registry
+
+    if tiny:
+        T, E, B, n_batches = 4, 4, 4, 3
+    else:
+        T, E, B, n_batches = 20, 8, 8, 6
+    cfg = configs.ExperimentConfig(
+        name="bench_ring",
+        env_family="atari",
+        env_id="PongNoFrameskip-v4",
+        obs_shape=(84, 84, 4),
+        obs_dtype="uint8",
+        num_actions=6,
+    )
+    factory = configs.make_env_factory(cfg, fake=True)
+    agent = Agent(ImpalaNet(num_actions=6, torso=AtariShallowTorso()))
+    try:
+        device = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        device = None
+
+    def measure(use_ring: bool):
+        reg = Registry()  # isolated registry: per-arm telemetry deltas
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
+            config=LearnerConfig(
+                batch_size=B,
+                unroll_length=T,
+                publish_interval=1_000_000,
+                traj_ring=use_ring,
+                # Host-copy measurement: the AOT layout compile is a
+                # device-step concern and would dominate the wall time.
+                auto_layouts=False,
+            ),
+            example_obs=configs.example_obs(cfg),
+            rng=jax.random.key(0),
+            telemetry=reg,
+        )
+        envs = [factory(1000 + j, j) for j in range(E)]
+        actor = VectorActor(
+            actor_id=0,
+            envs=envs,
+            agent=agent,
+            param_store=learner.param_store,
+            enqueue=learner.enqueue,
+            unroll_length=T,
+            seed=7,
+            device=device,
+            telemetry=reg,
+            traj_ring=learner.traj_ring,
+        )
+        learner.start()
+        batches = []
+        t0 = time.perf_counter()
+        try:
+            for _ in range(n_batches):
+                for _ in range(B // E):
+                    actor.unroll_and_push()
+                arrays, _ = learner._batch_q.get(timeout=300)
+                # Owning copies: queued device arrays on the CPU backend
+                # can be views whose buffers the allocator later reuses.
+                batches.append(
+                    jax.tree.map(lambda x: np.array(x, copy=True), arrays)
+                )
+            dt = time.perf_counter() - t0
+        finally:
+            learner.stop()
+        snap = reg.snapshot()
+        unrolls = n_batches * B
+        entry = {
+            "host_stack_ms": round(
+                float(snap["telemetry/learner/host_stack_ms"]), 4
+            ),
+            "stack_copy_bytes_per_unroll": round(
+                snap["telemetry/learner/host_stack_bytes"] / unrolls, 1
+            ),
+            "ring_stage_bytes_per_unroll": round(
+                snap["telemetry/learner/ring_stage_bytes"] / unrolls, 1
+            ),
+            "batches_per_sec": round(n_batches / dt, 2),
+        }
+        if use_ring:
+            entry["ring_occupancy"] = round(
+                float(snap["telemetry/ring/occupancy"]), 3
+            )
+            entry["recycle_wait_ms_p95"] = round(
+                float(snap["telemetry/ring/recycle_wait_ms_p95"]), 3
+            )
+        return entry, batches
+
+    queue_entry, queue_batches = measure(False)
+    ring_entry, ring_batches = measure(True)
+    identical = True
+    for bq, br in zip(queue_batches, ring_batches):
+        for a, b in zip(jax.tree.leaves(bq), jax.tree.leaves(br)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                identical = False
+    out = {
+        "shapes": f"T={T} E={E} B={B} x {n_batches} batches, 84x84x4 uint8",
+        "queue": queue_entry,
+        "ring": ring_entry,
+        "batches_bit_identical": identical,
+        "host_stack_ms_ratio": round(
+            ring_entry["host_stack_ms"]
+            / max(queue_entry["host_stack_ms"], 1e-9),
+            4,
+        ),
+    }
+    log(f"bench: traj_ring: {out}")
     return out
 
 

@@ -70,3 +70,30 @@ def test_canonical_flops_fused_k_counts_one_step(jax_cpu):
     if f_plain == 0 or f_fused == 0:
         pytest.skip("cost_analysis unavailable on this backend")
     assert abs(f_fused - f_plain) / f_plain < 0.10, (f_plain, f_fused)
+
+
+def test_traj_ring_bench_overhead_bound(jax_cpu):
+    """The ISSUE 3 acceptance bound, wired into CI via the bench
+    section's tiny variant: with the trajectory ring enabled on fake
+    Pong envs, batches stay BIT-IDENTICAL to the queue path on fixed
+    seeds, the per-unroll enqueue copy (`learner/host_stack_bytes`)
+    drops to zero, and the host_stack span shrinks. Bytes are the
+    machine-exact bound; the span assert keeps slack for CI timing
+    noise (the measured ratio is ~0.14 on this box)."""
+    from bench import run_bench_traj_ring
+
+    out = run_bench_traj_ring(jax_cpu, tiny=True)
+    assert out["batches_bit_identical"]
+    q, r = out["queue"], out["ring"]
+    # The queue path really copies every unroll at stack time...
+    assert q["stack_copy_bytes_per_unroll"] > 100_000, q
+    # ...and the ring path copies NOTHING at the enqueue/stack stage.
+    assert r["stack_copy_bytes_per_unroll"] == 0, r
+    # Aliasing-fallback staging (CPU backend) never exceeds what the
+    # queue path copied — the ring is at worst copy-parity at the
+    # transfer stage and copy-free at the stack stage.
+    assert (
+        r["ring_stage_bytes_per_unroll"]
+        <= q["stack_copy_bytes_per_unroll"]
+    ), out
+    assert r["host_stack_ms"] < q["host_stack_ms"], out

@@ -217,6 +217,96 @@ class TestAsyncPool:
             make_pool(mode="eager")
         with pytest.raises(ValueError, match="ready_fraction"):
             make_pool(mode="async", ready_fraction=0.0)
+        with pytest.raises(ValueError, match="ready_fraction"):
+            make_pool(mode="async", ready_fraction="bogus")
+
+    def test_wait_any_copy_false_returns_lane_views(self):
+        """copy=False hands back direct shm-lane views (the ROADMAP
+        lane-fold: callers copy once, straight into unroll buffers)."""
+        pool = make_pool(num_workers=1, envs_per_worker=2, mode="async")
+        try:
+            pool.reset_all()
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            ((_, rew, dn, _, ok),) = pool.wait_any(copy=False)
+            assert ok
+            assert np.shares_memory(rew, pool._rew_lane)
+            assert np.shares_memory(dn, pool._done_lane)
+            np.testing.assert_array_equal(rew, 1.0)
+            # Default copy=True stays an owning copy.
+            assert pool.submit(0, np.zeros((2,), np.int32))
+            ((_, rew2, dn2, _, _),) = pool.wait_any()
+            assert not np.shares_memory(rew2, pool._rew_lane)
+            assert not np.shares_memory(dn2, pool._done_lane)
+        finally:
+            pool.close()
+
+    def test_step_all_out_buffers_filled_in_place(self):
+        """out_rewards/out_dones fold the shm lanes straight into
+        caller buffers: every row written, stale contents never leak."""
+        pool = make_pool(num_workers=2, envs_per_worker=2)
+        try:
+            pool.reset_all()
+            rewards = np.full((4,), 99.0, np.float32)
+            dones = np.ones((4,), np.bool_)
+            _, r, d, _ = pool.step_all(
+                np.zeros(4), out_rewards=rewards, out_dones=dones
+            )
+            assert r is rewards and d is dones
+            np.testing.assert_array_equal(rewards, 1.0)
+            assert not dones.any()  # stale True rows overwritten
+        finally:
+            pool.close()
+
+
+class TestAutoReadyFraction:
+    """pool_ready_fraction="auto": the EWMA straggler-rate tuner
+    (ROADMAP remaining idea). Observations are injected by backdating
+    _submit_t so the tests drive the tuner without real slow envs."""
+
+    def _observe(self, pool, dur_s, n=1):
+        import time as _time
+
+        for _ in range(n):
+            pool._submit_t[0] = _time.monotonic() - dur_s
+            pool._observe_step(0)
+
+    def test_auto_accepted_and_starts_at_default(self):
+        pool = make_pool(mode="async", ready_fraction="auto")
+        try:
+            assert pool._auto_fraction
+            assert pool.ready_fraction == 0.5
+        finally:
+            pool.close()
+
+    def test_no_stragglers_drifts_to_full_waves(self):
+        pool = make_pool(mode="async", ready_fraction="auto")
+        try:
+            self._observe(pool, 1e-3, n=128)  # uniform normal steps
+            assert pool.ready_fraction == 1.0
+        finally:
+            pool.close()
+
+    def test_straggler_burst_shrinks_waves(self):
+        pool = make_pool(mode="async", ready_fraction="auto")
+        try:
+            self._observe(pool, 1e-3, n=32)  # establish a normal EWMA
+            for i in range(128):  # ~50% stalls, well over floor + 2x
+                self._observe(pool, 0.05 if i % 2 else 1e-3)
+            assert pool.ready_fraction == pool.AUTO_FRACTION_MIN
+            # Recovery: straggler-free steps re-widen the waves (the
+            # EWMA decays geometrically, so near-full, not exactly 1.0).
+            self._observe(pool, 1e-3, n=256)
+            assert pool.ready_fraction > 0.9
+        finally:
+            pool.close()
+
+    def test_fixed_fraction_never_retunes(self):
+        pool = make_pool(mode="async", ready_fraction=0.5)
+        try:
+            self._observe(pool, 1e-3, n=64)
+            assert pool.ready_fraction == 0.5
+        finally:
+            pool.close()
 
     def test_reset_all_drains_in_flight_steps(self):
         """A respawned inference actor can re-attach while its

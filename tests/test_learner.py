@@ -636,6 +636,70 @@ class TestStackBufferReuse:
             )
 
 
+class TestStackReuseAutoProbe:
+    """Both branches of the "auto" aliasing probe in
+    `Learner._stack_reuse_enabled` (previously only exercised by
+    whichever way THIS backend's alignment lottery happened to fall):
+    an aliasing-capable device_put must disable reuse, a copying one
+    must enable it, and the probe's verdict must be cached."""
+
+    def _learner(self):
+        return Learner(
+            agent=_agent(),
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(batch_size=2, unroll_length=3),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+        )
+
+    @staticmethod
+    def _trajs(learner, n=2, T=3):
+        _push_unrolls(learner, learner._agent, n, T)
+        return list(learner._traj_q.queue)
+
+    def test_aliasing_backend_disables_reuse(self, monkeypatch):
+        learner = self._learner()
+        monkeypatch.setattr(np, "shares_memory", lambda *a, **k: True)
+        assert learner._stack_reuse_enabled() is False
+        # Consequence: the batcher stacks into fresh allocations — no
+        # ring buffer is ever handed out or allocated.
+        trajs = self._trajs(learner)
+        assert learner._stack_out(trajs) is None
+        assert all(b is None for b in learner._ring)
+        # The verdict is cached: a later (different) probe result must
+        # not flip it mid-run under queued batches.
+        monkeypatch.setattr(np, "shares_memory", lambda *a, **k: False)
+        assert learner._stack_reuse_enabled() is False
+
+    def test_copying_backend_enables_reuse(self, monkeypatch):
+        learner = self._learner()
+        monkeypatch.setattr(np, "shares_memory", lambda *a, **k: False)
+        assert learner._stack_reuse_enabled() is True
+        trajs = self._trajs(learner)
+        out = learner._stack_out(trajs)
+        assert out is not None  # ring buffer allocated and handed out
+        batch = stack_trajectories(trajs, out=out)
+        ref = stack_trajectories(trajs)
+        np.testing.assert_array_equal(batch.obs, ref.obs)
+        monkeypatch.setattr(np, "shares_memory", lambda *a, **k: True)
+        assert learner._stack_reuse_enabled() is True  # cached
+
+    def test_probe_runs_at_most_once(self, monkeypatch):
+        learner = self._learner()
+        calls = []
+
+        def counting_shares_memory(*a, **k):
+            calls.append(1)
+            return False
+
+        monkeypatch.setattr(np, "shares_memory", counting_shares_memory)
+        learner._stack_reuse_enabled()
+        n = len(calls)
+        assert n >= 1  # the probe actually consulted the backend
+        learner._stack_reuse_enabled()
+        assert len(calls) == n
+
+
 def test_fused_dispatch_never_overshoots_budget():
     """run(max_steps) with K>1 stops at the largest multiple of K <=
     max_steps and warns about the unspent remainder."""

@@ -115,8 +115,17 @@ class ExperimentConfig:
     # `pool_ready_fraction` of workers has reported and lets stragglers
     # catch up on the next wave (runtime/env_pool.py). Lockstep stays the
     # default and the test baseline; async is opt-in per preset.
+    # `pool_ready_fraction` also accepts "auto": the pool retunes the
+    # fraction from an EWMA of its own straggler flags (the measured
+    # rate->fraction line from bench.py's env_pool section).
     pool_mode: str = "lockstep"
-    pool_ready_fraction: float = 0.5
+    pool_ready_fraction: float | str = 0.5
+    # Zero-copy trajectory ring (runtime/traj_ring.py): actors write
+    # unrolls straight into preallocated learner batch slots — the
+    # shm-lane -> Trajectory -> np.stack copy chain collapses to one
+    # write. Opt-in; needs vectorized actors whose env counts divide
+    # batch_size and the single-device K=1 learner (LearnerConfig docs).
+    traj_ring: bool = False
     unroll_length: int = 20
     batch_size: int = 8
     # Fuse K SGD steps into one dispatched XLA program (lax.scan over a
@@ -292,6 +301,7 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
         ),
         max_grad_norm=cfg.max_grad_norm,
         steps_per_dispatch=cfg.steps_per_dispatch,
+        traj_ring=cfg.traj_ring,
         popart=(
             PopArtConfig(
                 num_values=cfg.num_tasks, step_size=cfg.popart_step_size

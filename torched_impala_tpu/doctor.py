@@ -165,6 +165,57 @@ def _check_telemetry() -> tuple[str, str]:
         return "FAIL", f"telemetry stack broken:\n{traceback.format_exc()}"
 
 
+def _check_traj_ring() -> tuple[str, str]:
+    """Validate the zero-copy trajectory ring against real preset env
+    specs: slot dtypes/shapes must match what the preset's envs emit
+    (obs shape/dtype, logits width = action-space size), and the
+    acquire -> commit -> pop -> release cycle must round-trip. Purely
+    local (tiny slots, no pools or devices); catches a config/ring
+    shape drift at doctor time instead of as garbled batches mid-run."""
+    import numpy as np
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.runtime.traj_ring import TrajectoryRing
+
+    try:
+        checked = []
+        for name in ("cartpole", "pong"):
+            cfg = configs.REGISTRY[name]
+            obs = configs.example_obs(cfg)
+            agent = configs.make_agent(cfg)
+            ring = TrajectoryRing(
+                num_slots=2,
+                unroll_length=3,
+                batch_size=2,
+                example_obs=obs,
+                num_actions=cfg.num_actions,
+                agent_state_example=agent.initial_state(1),
+            )
+            problems = ring.validate_env_spec(obs, cfg.num_actions)
+            if problems:
+                return "FAIL", (
+                    f"{name}: slot/env spec mismatch: " + "; ".join(problems)
+                )
+            # Roundtrip: one 2-column block fills a whole slot.
+            block = ring.acquire(2)
+            for arr in (block.obs, block.first, block.actions,
+                        block.behaviour_logits, block.rewards, block.cont,
+                        block.task):
+                arr[...] = np.zeros_like(arr)
+            ring.commit(block, param_version=5)
+            view = ring.pop_ready(timeout=1.0)
+            assert view is not None and view.param_version == 5, view
+            assert view.arrays[0].shape == (4, 2) + obs.shape
+            ring.release(view.slot)
+            checked.append(name)
+        return "ok", (
+            f"slot dtypes/shapes match env specs ({', '.join(checked)}); "
+            "acquire->commit->pop->release roundtrip ok"
+        )
+    except Exception:
+        return "FAIL", f"traj ring broken:\n{traceback.format_exc()}"
+
+
 def _train_probe(config_name: str) -> tuple[str, str]:
     """Two real learner steps through the full runtime on the preset's
     REAL envs (no fakes) — the end-to-end first-contact check."""
@@ -258,6 +309,9 @@ def run_doctor(config_name: str | None = None) -> int:
     status, detail = _check_telemetry()
     print(f"  telemetry  [{status}] {detail}")
     failed = status == "FAIL"
+    status, detail = _check_traj_ring()
+    print(f"  traj ring  [{status}] {detail}")
+    failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)
         print(f"  env {family:10s} [{status}] {detail}")

@@ -53,9 +53,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "inference over the ready fraction of workers "
                         "instead of gating every wave on stragglers "
                         "(runtime/env_pool.py)")
-    p.add_argument("--pool-ready-fraction", type=float, default=None,
+    p.add_argument("--pool-ready-fraction", default=None,
+                   type=lambda s: s if s == "auto" else float(s),
                    help="async pool wave size as a fraction of workers "
-                        "(0 < f <= 1; default 0.5)")
+                        "(0 < f <= 1; default 0.5), or 'auto' to let "
+                        "the pool retune it from an EWMA of its own "
+                        "straggler rate (runtime/env_pool.py)")
+    p.add_argument("--traj-ring", action="store_true",
+                   help="zero-copy trajectory ring: actors write unrolls "
+                        "straight into preallocated learner batch slots "
+                        "(no per-env Trajectory arrays, no np.stack); "
+                        "needs vectorized actors whose env counts divide "
+                        "batch-size and the single-device K=1 learner "
+                        "(runtime/traj_ring.py)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--unroll-length", type=int, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=None,
@@ -200,6 +210,8 @@ def build_config(args: argparse.Namespace):
             overrides[field] = v
     if args.remat_torso:
         overrides["remat_torso"] = True
+    if args.traj_ring:
+        overrides["traj_ring"] = True
     cfg = dataclasses.replace(cfg, **overrides) if overrides else cfg
     if args.env_id is not None and not args.fake_envs:
         # The preset's num_actions describes its ORIGINAL env; a

@@ -21,6 +21,9 @@ from torched_impala_tpu.runtime.learner import (  # noqa: F401
 )
 from torched_impala_tpu.runtime.loop import TrainResult, train  # noqa: F401
 from torched_impala_tpu.runtime.param_store import ParamStore  # noqa: F401
+from torched_impala_tpu.runtime.traj_ring import (  # noqa: F401
+    TrajectoryRing,
+)
 from torched_impala_tpu.runtime.supervisor import (  # noqa: F401
     ActorSupervisor,
 )
@@ -47,6 +50,7 @@ __all__ = [
     "crossed_interval",
     "TrainResult",
     "Trajectory",
+    "TrajectoryRing",
     "VectorActor",
     "stack_superbatch",
     "stack_trajectories",

@@ -260,7 +260,22 @@ class ProcessEnvPool:
             raise ValueError(
                 f"unknown pool mode {mode!r}; expected 'lockstep' or 'async'"
             )
-        if not 0.0 < ready_fraction <= 1.0:
+        # "auto": EWMA straggler-rate tuner (ROADMAP remaining idea). The
+        # bench.py env_pool measurements say the best fraction tracks the
+        # straggler rate — 0.25 won at 10% injected stragglers (1.81x
+        # lockstep) while every fraction ties without stragglers (the
+        # grace window coalesces full batches) — so the tuner maps an
+        # EWMA of the pool's own straggler flags onto that measured line
+        # and retunes every AUTO_FRACTION_INTERVAL observed steps.
+        self._auto_fraction = ready_fraction == "auto"
+        if self._auto_fraction:
+            ready_fraction = 0.5  # the historical default, until evidence
+        elif isinstance(ready_fraction, str):
+            raise ValueError(
+                f"ready_fraction must be a float in (0, 1] or 'auto', "
+                f"got {ready_fraction!r}"
+            )
+        if not 0.0 < float(ready_fraction) <= 1.0:
             raise ValueError(
                 f"ready_fraction must be in (0, 1], got {ready_fraction}"
             )
@@ -283,7 +298,9 @@ class ProcessEnvPool:
         self._max_restarts = max_restarts
         self._step_timeout = step_timeout
         self.mode = mode
-        self.ready_fraction = ready_fraction
+        self.ready_fraction = float(ready_fraction)
+        self._straggler_ewma = 0.0  # EWMA of the per-step straggler flag
+        self._auto_obs = 0
         self.restarts = 0
 
         # Telemetry (docs/OBSERVABILITY.md "pool" rows). Worker step
@@ -308,6 +325,10 @@ class ProcessEnvPool:
             return len(pool._in_flight) / pool._num_workers
 
         reg.gauge("pool/lane_occupancy", fn=_occupancy)
+        # The (possibly auto-tuned) wave-size fraction the driving actor
+        # reads — exported so a dashboard can watch the tuner move.
+        self._m_ready_fraction = reg.gauge("pool/ready_fraction")
+        self._m_ready_fraction.set(self.ready_fraction)
         self._submit_t = [0.0] * num_workers
         self._step_ewma: Optional[float] = None
 
@@ -418,12 +439,24 @@ class ProcessEnvPool:
     # emulator stalls — GC pauses, level loads — sit well above 5ms.
     STRAGGLER_FLOOR_S = 5e-3
 
+    # ready_fraction="auto" tuner constants: straggler-flag EWMA weight,
+    # retune period (observed steps), and the rate->fraction line fit to
+    # the bench.py env_pool measurements — rate 0 maps to 1.0 (full
+    # coalesced waves; parity without stragglers at every fraction) and
+    # rate 0.1 maps to the 0.25 floor (the measured 1.81x winner at 10%
+    # injected stragglers).
+    AUTO_FRACTION_ALPHA = 1.0 / 32.0
+    AUTO_FRACTION_INTERVAL = 32
+    AUTO_FRACTION_SLOPE = 7.5
+    AUTO_FRACTION_MIN = 0.25
+
     def _observe_step(self, w: int) -> None:
         """Record worker `w`'s submit->ack latency into the step
         histogram, and count it as a straggler when it exceeds 2x the
         pool's EWMA of NORMAL steps (stalls are excluded from the EWMA so
         a burst of stragglers can't redefine normal) AND the absolute
-        floor above."""
+        floor above. In ready_fraction="auto" mode the straggler flag
+        also feeds the wave-size tuner."""
         t0 = self._submit_t[w]
         if t0 <= 0.0:
             return
@@ -431,13 +464,33 @@ class ProcessEnvPool:
         dur = time.monotonic() - t0
         self._m_step_ms.observe(dur * 1e3)
         ewma = self._step_ewma
+        is_straggler = False
         if ewma is None:
             self._step_ewma = dur
         elif dur >= 2.0 * ewma:
             if dur >= self.STRAGGLER_FLOOR_S:
+                is_straggler = True
                 self._m_stragglers.inc()
         else:
             self._step_ewma = 0.8 * ewma + 0.2 * dur
+        if self._auto_fraction:
+            a = self.AUTO_FRACTION_ALPHA
+            self._straggler_ewma = (1.0 - a) * self._straggler_ewma + a * (
+                1.0 if is_straggler else 0.0
+            )
+            self._auto_obs += 1
+            if self._auto_obs % self.AUTO_FRACTION_INTERVAL == 0:
+                self._update_auto_fraction()
+
+    def _update_auto_fraction(self) -> None:
+        """Map the straggler-rate EWMA onto the measured rate->fraction
+        line (see the AUTO_FRACTION_* constants). Only `ready_fraction`
+        mutates — the driving actor re-reads it at each unroll start, so
+        wave sizing stays fixed WITHIN an unroll (the jitted step keeps
+        its bounded compiled-shape set) and retunes between unrolls."""
+        frac = 1.0 - self.AUTO_FRACTION_SLOPE * self._straggler_ewma
+        self.ready_fraction = min(1.0, max(self.AUTO_FRACTION_MIN, frac))
+        self._m_ready_fraction.set(self.ready_fraction)
 
     def _restart(self, w: int, reason: str) -> None:
         self._in_flight.discard(w)  # a fresh worker has nothing in flight
@@ -506,7 +559,12 @@ class ProcessEnvPool:
                 self._restart(w, repr(e))
         return np.array(self._obs_block)  # copy out of the shared buffer
 
-    def step_all(self, actions: np.ndarray):
+    def step_all(
+        self,
+        actions: np.ndarray,
+        out_rewards: Optional[np.ndarray] = None,
+        out_dones: Optional[np.ndarray] = None,
+    ):
         """Step every env once; returns (next_obs, rewards, dones, events).
 
         Rows of `next_obs` for finished envs are fresh reset observations
@@ -514,10 +572,23 @@ class ProcessEnvPool:
         Worker failures are repaired in-line: the dead worker is respawned,
         its envs reset, its rows reported done with zero reward (the learner
         sees a clean episode boundary, not a poisoned trajectory).
+
+        `out_rewards` / `out_dones` (shape `[num_envs]`, float32/bool)
+        receive the reward/done lanes IN PLACE and are returned as the
+        rewards/dones results — the shm lanes fold straight into the
+        caller's unroll (or trajectory-ring) buffers, skipping one copy
+        per step (ROADMAP env-side item). Every row is written each call,
+        so stale contents never leak through.
         """
         n = self.num_envs
-        rewards = np.zeros((n,), np.float32)
-        dones = np.zeros((n,), np.bool_)
+        rewards = (
+            out_rewards if out_rewards is not None
+            else np.zeros((n,), np.float32)
+        )
+        dones = (
+            out_dones if out_dones is not None
+            else np.zeros((n,), np.bool_)
+        )
         events: List[Tuple[int, float, int]] = []
         self._act_lane[:] = np.asarray(actions, np.int32)
         # Workers whose command could not even be SENT (abrupt process
@@ -534,7 +605,10 @@ class ProcessEnvPool:
         for w in range(self._num_workers):
             sl = self._worker_slice(w)
             if w in dead:
-                # Fresh worker wrote reset obs; mark an episode boundary.
+                # Fresh worker wrote reset obs; mark a zero-reward
+                # episode boundary (explicit writes: with out_* buffers
+                # the rows may hold a previous step's data).
+                rewards[sl] = 0.0
                 dones[sl] = True
                 continue
             try:
@@ -556,6 +630,7 @@ class ProcessEnvPool:
                 )
             except (EOFError, OSError, TimeoutError, RuntimeError) as e:
                 self._restart(w, repr(e))
+                rewards[sl] = 0.0
                 dones[sl] = True
         return np.array(self._obs_block), rewards, dones, events
 
@@ -595,7 +670,12 @@ class ProcessEnvPool:
             False,
         )
 
-    def wait_any(self, workers=None, timeout: Optional[float] = None):
+    def wait_any(
+        self,
+        workers=None,
+        timeout: Optional[float] = None,
+        copy: bool = True,
+    ):
         """Block until at least one in-flight worker acks its step; return
         every ack available as [(w, rewards[E], dones[E], events, ok)].
 
@@ -608,7 +688,14 @@ class ProcessEnvPool:
         An explicit `timeout` makes the call a bounded poll that returns
         [] when nothing is ready (timeout=0 = non-blocking sweep of
         already-buffered acks); only the DEFAULT full step timeout implies
-        dead workers and triggers the repair-all path."""
+        dead workers and triggers the repair-all path.
+
+        `copy=False` hands back direct VIEWS of the shm reward/done
+        lanes instead of fresh copies: valid until the NEXT submit() for
+        that worker (the worker rewrites its lanes only while a step is
+        in flight), so a caller that copies each result straight into
+        its unroll buffers — `VectorActor.advance` does — skips one copy
+        per ack (the ROADMAP lane-fold item)."""
         waiting = sorted(
             self._in_flight if workers is None
             else self._in_flight & set(workers)
@@ -646,8 +733,10 @@ class ProcessEnvPool:
                 results.append(
                     (
                         w,
-                        self._rew_lane[sl].copy(),
-                        self._done_lane[sl].copy(),
+                        self._rew_lane[sl].copy() if copy
+                        else self._rew_lane[sl],
+                        self._done_lane[sl].copy() if copy
+                        else self._done_lane[sl],
                         events,
                         True,
                     )

@@ -18,6 +18,7 @@ stacks), TPU-first:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
@@ -49,12 +50,14 @@ from torched_impala_tpu.parallel.mesh import (
 )
 from torched_impala_tpu.parallel import multihost
 from torched_impala_tpu.runtime.param_store import ParamStore
-from torched_impala_tpu.telemetry.registry import get_registry
+from torched_impala_tpu.runtime.traj_ring import TrajectoryRing
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
 from torched_impala_tpu.runtime.types import (
     QueueClosed,
     Trajectory,
     crossed_interval,
     host_snapshot,
+    tree_nbytes,
 )
 
 
@@ -126,6 +129,20 @@ class LearnerConfig:
     # formats don't transfer). The step itself is AOT-compiled on the
     # first batch; numerics are identical (layouts don't change math).
     auto_layouts: bool = True
+    # Zero-copy trajectory ring (runtime/traj_ring.py): vectorized
+    # actors write unrolls straight into preallocated [T+1, B, ...]
+    # learner batch slots and the batcher device_puts a completed slot
+    # with NO host stacking — the shm-lanes -> Trajectory -> np.stack
+    # copy chain collapses to one actor-side write. Opt-in (default
+    # off); single-device K=1 path only (the [K, ...] superbatch and
+    # mesh place_batch keep the queue path), and the actor fleet must be
+    # vectorized with env counts dividing batch_size (loop.py checks).
+    # Recycling is free-list + generation counters; a slot returns only
+    # after its H2D copy completes. On backends where device_put can
+    # ALIAS host numpy (the stack_buffer_reuse probe), each batch is
+    # staged through one owning copy instead — still one copy fewer
+    # than the queue path's actor-buffer + stack chain.
+    traj_ring: bool = False
     # Backend NAME ("cpu") the batcher device_puts assembled batches to,
     # instead of the default device. A measurement/staging knob (bench's
     # feeder section uses it to time the ingest path against the local
@@ -300,6 +317,7 @@ class Learner:
         rng: jax.Array,
         logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
         mesh: Optional[Mesh] = None,
+        telemetry: Optional[Registry] = None,
     ) -> None:
         """`mesh=None` → single-device jit; `mesh=Mesh(..., ('data','model'))`
         → batch sharded over `data` (gradient all-reduce inserted by the
@@ -459,10 +477,16 @@ class Learner:
         # host stacking, H2D dispatch, the XLA step, and param publish —
         # together with queue depth / batch wait they localize the
         # pipeline bottleneck. Resolved once; spans cost two monotonic()
-        # reads + one lock on a many-ms stage.
-        reg = get_registry()
+        # reads + one lock on a many-ms stage. `telemetry` overrides the
+        # global registry (benchmarks isolate runs with fresh ones).
+        reg = telemetry if telemetry is not None else get_registry()
         self._telemetry = reg
         self._m_host_stack = reg.timer("learner/host_stack")
+        # Bytes the stacking path COPIES per batch (the number the
+        # trajectory ring drives to 0) and, ring mode only, bytes staged
+        # through the aliasing-fallback owning copy before device_put.
+        self._m_host_stack_bytes = reg.counter("learner/host_stack_bytes")
+        self._m_ring_stage_bytes = reg.counter("learner/ring_stage_bytes")
         self._m_device_put = reg.timer("learner/device_put")
         self._m_train_step = reg.timer("learner/train_step")
         self._m_publish = reg.timer("learner/publish")
@@ -481,6 +505,37 @@ class Learner:
             return float("nan") if q is None else q.qsize()
 
         reg.gauge("queue/depth", fn=_depth)
+
+        # Zero-copy trajectory ring (LearnerConfig.traj_ring): slots are
+        # complete [T+1, B, ...] batches actors write in place. Sized so
+        # the device queue can hold its depth in transferred slots while
+        # one slot fills and one spare absorbs jitter.
+        self.traj_ring: Optional[TrajectoryRing] = None
+        if config.traj_ring:
+            if mesh is not None:
+                raise ValueError(
+                    "traj_ring supports the single-device learner only "
+                    "(mesh batches go through the sharded queue path)"
+                )
+            if config.data_device is not None:
+                raise ValueError(
+                    "traj_ring cannot combine with data_device (the "
+                    "measurement knob keeps the queue path)"
+                )
+            if config.steps_per_dispatch != 1:
+                raise ValueError(
+                    "traj_ring requires steps_per_dispatch=1 (the "
+                    "[K, ...] superbatch keeps the queue path)"
+                )
+            self.traj_ring = TrajectoryRing(
+                num_slots=config.device_queue_depth + 2,
+                unroll_length=config.unroll_length,
+                batch_size=self._local_batch_size,
+                example_obs=np.asarray(example_obs),
+                num_actions=agent.net.num_actions,
+                agent_state_example=agent.initial_state(1),
+                telemetry=reg,
+            )
 
         self.param_store = ParamStore()
         self._publish()
@@ -1001,7 +1056,28 @@ class Learner:
         if trajs is None:
             return None
         with self._m_host_stack.time():
-            return stack_trajectories(trajs, out=self._stack_out(trajs))
+            batch = stack_trajectories(trajs, out=self._stack_out(trajs))
+        self._count_stack_bytes(batch)
+        return batch
+
+    def _count_stack_bytes(self, batch: Trajectory) -> None:
+        """Account the bytes `stack_trajectories` just copied — the
+        per-batch host copy cost the trajectory ring eliminates
+        (bench.py traj_ring section reads this counter)."""
+        self._m_host_stack_bytes.inc(
+            tree_nbytes(
+                (
+                    batch.obs,
+                    batch.first,
+                    batch.actions,
+                    batch.behaviour_logits,
+                    batch.rewards,
+                    batch.cont,
+                    batch.task,
+                    batch.agent_state,
+                )
+            )
+        )
 
     def _assemble_superbatch(self, K: int) -> Optional[Trajectory]:
         """`[K, ...]` superbatch, each slice stacked in place so every
@@ -1034,9 +1110,65 @@ class Learner:
                 versions.append(
                     stack_trajectories(trajs, out=view).param_version
                 )
+            self._count_stack_bytes(view)
         return sb._replace(param_version=min(versions))
 
+    def _validate_tasks(self, task: np.ndarray) -> None:
+        if self._config.popart is None:
+            return
+        bad = int(task.max(initial=0))
+        if bad >= self._config.popart.num_values or task.min(
+            initial=0
+        ) < 0:
+            raise ValueError(
+                f"actor task ids "
+                f"{sorted(set(task.ravel().tolist()))} "
+                f"out of range for PopArt num_values="
+                f"{self._config.popart.num_values}"
+            )
+
+    def _put_batch(self, arrays):
+        """H2D placement of one assembled batch 8-tuple, honoring
+        data_device / AUTO-layout formats / the mesh — shared by the
+        queue and trajectory-ring batcher loops."""
+        if self._data_device is not None:
+            return jax.device_put(arrays, self._data_device)
+        if self._mesh is None:
+            # Locals, not repeated attribute reads: step_once's
+            # layout-mismatch fallback nulls these from the main
+            # thread and must not race this thread mid-branch.
+            if self._auto_jit is not None:
+                # First batch: AOT-compile with XLA-chosen layouts
+                # and learn the batch input formats; later batches
+                # transfer STRAIGHT into the step's preferred
+                # layouts (no in-step relayout).
+                if self._batch_formats is None:
+                    self._ensure_auto_compiled(arrays)
+                fmts = self._batch_formats
+            else:
+                fmts = None
+            if fmts is not None:
+                return jax.tree.map(_put_format, arrays, fmts)
+            return jax.device_put(arrays)
+        # Single-host: sharded device_put. Multi-host: this host's
+        # local slice becomes its shards of the global batch array.
+        return multihost.place_batch(self._batch_shardings, arrays)
+
+    def _push_device_batch(self, on_device, param_version: int) -> bool:
+        """Bounded put into the device queue; False when stopping."""
+        while True:
+            if self._stop.is_set():
+                return False
+            try:
+                self._batch_q.put((on_device, param_version), timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+
     def _batcher_loop_impl(self) -> None:
+        if self.traj_ring is not None:
+            self._ring_batcher_loop()
+            return
         K = self._config.steps_per_dispatch
         while not self._stop.is_set():
             batch = (
@@ -1046,17 +1178,7 @@ class Learner:
             )
             if batch is None:
                 return
-            if self._config.popart is not None:
-                bad = int(batch.task.max(initial=0))
-                if bad >= self._config.popart.num_values or batch.task.min(
-                    initial=0
-                ) < 0:
-                    raise ValueError(
-                        f"actor task ids "
-                        f"{sorted(set(batch.task.ravel().tolist()))} "
-                        f"out of range for PopArt num_values="
-                        f"{self._config.popart.num_values}"
-                    )
+            self._validate_tasks(batch.task)
             arrays = (
                 batch.obs,
                 batch.first,
@@ -1073,44 +1195,86 @@ class Learner:
             # flags the feed path, which is what the breakdown is for.
             put_span = self._m_device_put.time()
             put_span.__enter__()
-            if self._data_device is not None:
-                on_device = jax.device_put(arrays, self._data_device)
-            elif self._mesh is None:
-                # Locals, not repeated attribute reads: step_once's
-                # layout-mismatch fallback nulls these from the main
-                # thread and must not race this thread mid-branch.
-                if self._auto_jit is not None:
-                    # First batch: AOT-compile with XLA-chosen layouts
-                    # and learn the batch input formats; later batches
-                    # transfer STRAIGHT into the step's preferred
-                    # layouts (no in-step relayout).
-                    if self._batch_formats is None:
-                        self._ensure_auto_compiled(arrays)
-                    fmts = self._batch_formats
-                else:
-                    fmts = None
-                if fmts is not None:
-                    on_device = jax.tree.map(_put_format, arrays, fmts)
-                else:
-                    on_device = jax.device_put(arrays)
-            else:
-                # Single-host: sharded device_put. Multi-host: this host's
-                # local slice becomes its shards of the global batch array.
-                on_device = multihost.place_batch(
-                    self._batch_shardings, arrays
-                )
+            on_device = self._put_batch(arrays)
             put_span.__exit__()
             self._record_pending_transfer(on_device)
-            while True:
-                if self._stop.is_set():
-                    return
-                try:
-                    self._batch_q.put(
-                        (on_device, batch.param_version), timeout=0.5
+            if not self._push_device_batch(on_device, batch.param_version):
+                return
+
+    def _ring_batcher_loop(self) -> None:
+        """Trajectory-ring consumer: completed slots already ARE batches,
+        so the host_stack stage collapses to a view handoff and the slot
+        is device_put directly. Slots recycle only after their H2D copy
+        completes (`release_after_transfer`), bounded by the device
+        queue depth so recycling never gates the current transfer.
+
+        Aliasing backends (the stack_buffer_reuse probe says device_put
+        may ALIAS host numpy): recycling an aliased slot would corrupt
+        the queued batch, so each batch stages through ONE owning copy
+        instead and the slot recycles immediately — still one copy fewer
+        than the queue path's actor-buffer + np.stack chain; the copy is
+        accounted under learner/ring_stage_bytes, not host_stack."""
+        ring = self.traj_ring
+        keep = self._config.device_queue_depth
+        inflight: collections.deque = collections.deque()
+        copy_before_put = not self._stack_reuse_enabled()
+        alias_checked = False
+        while not self._stop.is_set():
+            view = ring.pop_ready(timeout=0.5)
+            if view is None:
+                continue
+            with self._m_host_stack.time():
+                arrays = view.arrays
+                if copy_before_put:
+                    arrays = jax.tree.map(
+                        lambda x: np.array(x, copy=True), arrays
                     )
-                    break
-                except queue.Full:
-                    continue
+            if copy_before_put:
+                self._m_ring_stage_bytes.inc(tree_nbytes(arrays))
+            self._validate_tasks(arrays[6])
+            put_span = self._m_device_put.time()
+            put_span.__enter__()
+            on_device = self._put_batch(arrays)
+            put_span.__exit__()
+            if copy_before_put:
+                # The staged copy owns its memory; the slot is free now.
+                ring.release(view.slot)
+            else:
+                leaves = jax.tree.leaves(on_device)
+                if not alias_checked:
+                    # One-time safety net (covers a force-"on"
+                    # stack_buffer_reuse on an aliasing backend the auto
+                    # probe would have rejected): if device arrays alias
+                    # the slot buffers, recycling would corrupt this
+                    # batch — leak this ONE slot (its buffers back the
+                    # live batch) and stage every later batch.
+                    alias_checked = True
+                    try:
+                        aliased = any(
+                            np.shares_memory(np.asarray(d), b)
+                            for d in leaves
+                            for b in jax.tree.leaves(view.arrays)
+                        )
+                    except Exception:
+                        aliased = False
+                    if aliased:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "traj_ring: device_put aliases slot buffers "
+                            "on this backend; staging batches through "
+                            "an owning copy (one slot leaked to protect "
+                            "the in-flight batch)"
+                        )
+                        copy_before_put = True
+                        leaves = None
+                if leaves is not None:
+                    inflight.append((view.slot, leaves))
+                    while len(inflight) > keep:
+                        s, pending = inflight.popleft()
+                        ring.release_after_transfer(s, pending)
+            if not self._push_device_batch(on_device, view.param_version):
+                return
 
     def start(self) -> None:
         if self._batcher_thread is None:
@@ -1121,6 +1285,11 @@ class Learner:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.traj_ring is not None:
+            # Wake actors blocked in ring.acquire (they raise QueueClosed
+            # and exit, mirroring enqueue's contract) and the batcher's
+            # pop_ready wait.
+            self.traj_ring.close()
 
     # ---- stepping ------------------------------------------------------
 

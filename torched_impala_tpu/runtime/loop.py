@@ -94,6 +94,16 @@ def train(
     - "async": ready-set batching — inference runs over whichever
       `pool_ready_fraction` of workers has reported, stragglers catch up
       on the next wave (runtime/env_pool.py async protocol).
+      `pool_ready_fraction="auto"` arms the pool's EWMA straggler-rate
+      tuner (the fraction tracks the measured straggler rate between
+      unrolls; env_pool.AUTO_FRACTION_* constants).
+
+    `learner_config.traj_ring=True` switches the actor->learner edge to
+    the zero-copy trajectory ring (runtime/traj_ring.py): actors write
+    unrolls straight into shared `[T+1, B, ...]` batch slots, the
+    batcher device_puts completed slots with no host stacking. Needs a
+    vectorized actor fleet whose env counts divide batch_size (checked
+    at startup) and the single-device K=1 learner path.
 
     Observability (docs/OBSERVABILITY.md):
     - `telemetry_interval=N` merges the global telemetry registry's
@@ -275,6 +285,28 @@ def train(
                 pool.close()
             raise
 
+    # Zero-copy trajectory ring (LearnerConfig.traj_ring): actors write
+    # unrolls straight into shared learner batch slots instead of
+    # enqueueing Trajectories. Every actor's env-column block must divide
+    # the batch so blocks never straddle a slot — checked HERE, where the
+    # actual fleet shapes are known, so a bad combination fails at
+    # startup instead of deadlocking the ring.
+    traj_ring = learner.traj_ring
+    if traj_ring is not None:
+        B = learner_config.batch_size
+        env_counts = (
+            {pool.num_envs for pool in env_pools}
+            if env_pools
+            else {max(1, envs_per_actor)}
+        )
+        for E in sorted(env_counts):
+            if E > B or B % E:
+                raise ValueError(
+                    f"traj_ring: actor env count {E} must divide "
+                    f"batch_size {B} (each unroll cycle fills whole "
+                    f"column blocks of one batch slot)"
+                )
+
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the
         # published params, so restart-after-crash just rebuilds the envs.
@@ -293,16 +325,21 @@ def train(
             # One batched-inference actor per pool; pools repair their own
             # dead workers, so a supervisor respawn of this actor just
             # re-attaches to the live pool.
-            return VectorActor(envs=env_pools[slot], **common)
-        if envs_per_actor > 1:
+            return VectorActor(
+                envs=env_pools[slot], traj_ring=traj_ring, **common
+            )
+        if envs_per_actor > 1 or traj_ring is not None:
+            # The ring path needs the vectorized (column-block) writer,
+            # so a 1-env thread actor rides VectorActor with E=1.
             return VectorActor(
                 envs=[
                     build_env(
                         base_seed + j,
                         (host_slot0 + slot) * envs_per_actor + j,
                     )
-                    for j in range(envs_per_actor)
+                    for j in range(max(1, envs_per_actor))
                 ],
+                traj_ring=traj_ring,
                 **common,
             )
         return Actor(

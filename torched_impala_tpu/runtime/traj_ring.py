@@ -1,0 +1,384 @@
+"""Zero-copy trajectory ring: actors write unrolls straight into the
+learner's stacking buffers.
+
+The host-side data path historically copied every unroll three times —
+shm lanes -> per-env `Trajectory` numpy arrays in `VectorActor.unroll`,
+`np.stack` into the batcher's ring buffers in `learner.py`, then
+`device_put`. TorchBeast's fix (arxiv 1910.03552 §2) is to keep rollout
+payloads in preallocated shared buffers and pass only indices; this
+module is that idea for the in-process actor↔learner edge:
+
+- a pool of `num_slots` preallocated, time-major `[T+1, B, ...]` unroll
+  SLOTS, each shaped exactly like `learner.alloc_stack_buffers` output
+  (obs / first / actions / behaviour_logits / rewards / cont / task /
+  agent_state), so a completed slot IS a learner batch;
+- actors `acquire(E)` a block of E columns of the filling slot and write
+  every timestep of the unroll directly into those columns (rewards/cont
+  straight out of the env pool's shm lanes, actions/logits at inference
+  time) — no per-env `Trajectory` arrays, no `np.stack`;
+- `commit(block, param_version)` publishes the columns; when a slot's B
+  columns are all committed it moves to the ready queue and the batcher
+  `device_put`s it with NO host stacking at all;
+- recycling is free-list + generation counters: the learner returns a
+  slot only after the H2D copy of its previous contents completes
+  (`release_after_transfer`), and a stale block (its slot recycled out
+  from under a crashed-and-respawned writer) fails loudly at commit.
+
+Backpressure falls out of the free-list: with all slots filling /
+ready / in flight, `acquire` blocks — exactly where the bounded
+trajectory queue used to block `enqueue`. Telemetry
+(docs/OBSERVABILITY.md "ring" rows): `ring/occupancy` (fraction of
+slots not free, read at snapshot time), `ring/acquire_block_ms`
+(actor-side wait for a free column block), `ring/recycle_wait_ms`
+(batcher-side wait for a slot's device copy before recycling),
+`ring/batches`, `ring/aborted_slots`.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Any, List, NamedTuple, Optional
+
+import jax
+import numpy as np
+
+from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
+
+
+class RingBlock(NamedTuple):
+    """A writer's view of E columns of one slot.
+
+    Array fields are numpy VIEWS into the slot buffers (`obs` is
+    `[T+1, E, ...]`, `actions`/`rewards`/`cont` `[T, E]`,
+    `behaviour_logits` `[T, E, A]`, `task` `[E]`, agent_state leaves
+    `[E, ...]`): writing a timestep row writes the learner batch
+    directly. `slot`/`gen` identify the reservation for commit/abort.
+    """
+
+    slot: int
+    cols: slice
+    gen: int
+    obs: np.ndarray
+    first: np.ndarray
+    actions: np.ndarray
+    behaviour_logits: np.ndarray
+    rewards: np.ndarray
+    cont: np.ndarray
+    task: np.ndarray
+    agent_state: Any
+
+
+class ReadySlot(NamedTuple):
+    """A completed slot handed to the batcher: `arrays` is the exact
+    8-tuple the train step consumes (no restacking), views into the slot
+    buffers — valid until `release(slot)`."""
+
+    slot: int
+    arrays: tuple
+    param_version: int
+
+
+class _Slot:
+    __slots__ = ("buffers", "versions", "gen", "next_col", "committed",
+                 "aborted")
+
+    def __init__(self, buffers: Trajectory, batch_size: int):
+        self.buffers = buffers
+        self.versions = np.zeros((batch_size,), np.int64)
+        self.gen = 0
+        self.next_col = 0  # columns handed out to writers
+        self.committed = 0  # columns committed or aborted
+        self.aborted = False
+
+
+class TrajectoryRing:
+    """Preallocated pool of `[T+1, B, ...]` unroll slots shared between
+    `VectorActor` writers and the `Learner` batcher."""
+
+    def __init__(
+        self,
+        *,
+        num_slots: int,
+        unroll_length: int,
+        batch_size: int,
+        example_obs: np.ndarray,
+        num_actions: int,
+        agent_state_example: Any = (),
+        telemetry: Optional[Registry] = None,
+    ) -> None:
+        if num_slots < 2:
+            # One slot can never overlap filling with an in-flight H2D
+            # transfer — the whole point of the ring.
+            raise ValueError(f"need >= 2 slots, got {num_slots}")
+        if unroll_length < 1 or batch_size < 1:
+            raise ValueError("unroll_length and batch_size must be >= 1")
+        obs = np.asarray(example_obs)
+        T, B = unroll_length, batch_size
+        self.unroll_length = T
+        self.batch_size = B
+        self.num_slots = num_slots
+        self.obs_shape = obs.shape
+        self.obs_dtype = obs.dtype
+        self.num_actions = int(num_actions)
+        # Per-env agent-state template (leaves [1, ...], the shape each
+        # Trajectory carries); slot leaves concatenate to [B, ...] —
+        # mirroring learner.alloc_stack_buffers exactly.
+        state_template = jax.tree.map(np.asarray, agent_state_example)
+
+        def slot_buffers() -> Trajectory:
+            def state(x):
+                return np.empty(
+                    (B * x.shape[0],) + x.shape[1:], x.dtype
+                )
+
+            return Trajectory(
+                obs=np.empty((T + 1, B) + obs.shape, obs.dtype),
+                first=np.empty((T + 1, B), np.bool_),
+                actions=np.empty((T, B), np.int32),
+                behaviour_logits=np.empty(
+                    (T, B, self.num_actions), np.float32
+                ),
+                rewards=np.empty((T, B), np.float32),
+                cont=np.empty((T, B), np.float32),
+                agent_state=jax.tree.map(state, state_template),
+                actor_id=-1,
+                param_version=0,
+                task=np.empty((B,), np.int32),
+            )
+
+        self._slots: List[_Slot] = [
+            _Slot(slot_buffers(), B) for _ in range(num_slots)
+        ]
+        self._free: collections.deque = collections.deque(range(num_slots))
+        self._ready: collections.deque = collections.deque()
+        self._filling: Optional[int] = None
+        self._closed = False
+        self._cond = threading.Condition()
+
+        reg = telemetry if telemetry is not None else get_registry()
+        self._m_acquire_ms = reg.histogram("ring/acquire_block_ms")
+        self._m_recycle_ms = reg.histogram("ring/recycle_wait_ms")
+        self._m_batches = reg.counter("ring/batches")
+        self._m_aborted = reg.counter("ring/aborted_slots")
+        # Occupancy (fraction of slots not on the free list) is read
+        # lazily at snapshot time; weakref so the global registry never
+        # keeps a dead ring's slot buffers alive.
+        ring_ref = weakref.ref(self)
+
+        def _occupancy() -> float:
+            ring = ring_ref()
+            if ring is None:
+                return float("nan")
+            return 1.0 - len(ring._free) / ring.num_slots
+
+        reg.gauge("ring/occupancy", fn=_occupancy)
+
+    # -- writer (actor) side ----------------------------------------------
+
+    def acquire(self, num_cols: int) -> RingBlock:
+        """Reserve `num_cols` columns of the filling slot; blocks while
+        every slot is busy (the ring's backpressure edge — the analog of
+        a full trajectory queue). Raises QueueClosed after `close()`.
+
+        `num_cols` must divide `batch_size` so blocks never straddle a
+        slot boundary (every writer's columns land in ONE batch)."""
+        if num_cols < 1 or self.batch_size % num_cols:
+            raise ValueError(
+                f"block of {num_cols} columns must divide batch_size "
+                f"{self.batch_size} (one batch = whole blocks only)"
+            )
+        t0 = time.monotonic()
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise QueueClosed()
+                if self._filling is None and self._free:
+                    self._filling = self._free.popleft()
+                if self._filling is not None:
+                    s = self._filling
+                    slot = self._slots[s]
+                    c0 = slot.next_col
+                    slot.next_col += num_cols
+                    if slot.next_col >= self.batch_size:
+                        self._filling = None  # fully handed out
+                    self._m_acquire_ms.observe(
+                        (time.monotonic() - t0) * 1e3
+                    )
+                    return self._block(s, slice(c0, c0 + num_cols))
+                self._cond.wait(timeout=0.5)
+
+    def _block(self, s: int, cols: slice) -> RingBlock:
+        slot = self._slots[s]
+        buf = slot.buffers
+        return RingBlock(
+            slot=s,
+            cols=cols,
+            gen=slot.gen,
+            obs=buf.obs[:, cols],
+            first=buf.first[:, cols],
+            actions=buf.actions[:, cols],
+            behaviour_logits=buf.behaviour_logits[:, cols],
+            rewards=buf.rewards[:, cols],
+            cont=buf.cont[:, cols],
+            task=buf.task[cols],
+            agent_state=jax.tree.map(lambda x: x[cols], buf.agent_state),
+        )
+
+    def commit(self, block: RingBlock, param_version: int) -> None:
+        """Publish a fully-written block. When the slot's last block
+        commits, the slot becomes a ready batch. Committing against a
+        recycled slot (generation mismatch — a stale writer) raises."""
+        with self._cond:
+            slot = self._slots[block.slot]
+            if slot.gen != block.gen:
+                raise RuntimeError(
+                    f"stale ring block: slot {block.slot} generation "
+                    f"{block.gen} was recycled (now {slot.gen}); the "
+                    "writer held its block across a slot recycle"
+                )
+            slot.versions[block.cols] = param_version
+            slot.committed += block.cols.stop - block.cols.start
+            self._maybe_complete_locked(block.slot)
+
+    def abort(self, block: RingBlock) -> None:
+        """Give up a block after a writer crash: its columns hold
+        garbage, so when the slot completes it is recycled instead of
+        delivered (the other writers' columns in it are dropped — one
+        lost batch window, never a poisoned one). Tolerates a stale
+        generation (the slot already moved on)."""
+        with self._cond:
+            slot = self._slots[block.slot]
+            if slot.gen != block.gen:
+                return
+            slot.aborted = True
+            slot.committed += block.cols.stop - block.cols.start
+            self._maybe_complete_locked(block.slot)
+
+    def _maybe_complete_locked(self, s: int) -> None:
+        slot = self._slots[s]
+        if slot.committed < self.batch_size:
+            return
+        if slot.aborted:
+            self._m_aborted.inc()
+            self._recycle_locked(s)
+        else:
+            self._ready.append(s)
+        self._cond.notify_all()
+
+    # -- consumer (learner batcher) side ----------------------------------
+
+    def pop_ready(self, timeout: Optional[float] = None) -> Optional[ReadySlot]:
+        """Next completed slot as the train step's 8-tuple of batch
+        arrays (views — valid until `release`); None on timeout or after
+        close. Batch param_version is the min over columns, matching
+        `stack_trajectories`."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._ready:
+                if self._closed:
+                    return None
+                budget = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if budget is not None and budget <= 0:
+                    return None
+                self._cond.wait(timeout=budget)
+            s = self._ready.popleft()
+            slot = self._slots[s]
+            self._m_batches.inc()
+            buf = slot.buffers
+            return ReadySlot(
+                slot=s,
+                arrays=(
+                    buf.obs,
+                    buf.first,
+                    buf.actions,
+                    buf.behaviour_logits,
+                    buf.rewards,
+                    buf.cont,
+                    buf.task,
+                    buf.agent_state,
+                ),
+                param_version=int(slot.versions.min()),
+            )
+
+    def release(self, s: int) -> None:
+        """Return slot `s` to the free list (generation bump invalidates
+        any stale blocks). Call only once its batch arrays are no longer
+        referenced — after the H2D copy completed (or after an owning
+        host copy was taken)."""
+        with self._cond:
+            self._recycle_locked(s)
+            self._cond.notify_all()
+
+    def release_after_transfer(self, s: int, pending) -> None:
+        """Block out slot `s`'s device transfer, then recycle it: until
+        `jax.block_until_ready` returns, jax's (possibly background-
+        dispatched) H2D copy may still read the slot's host buffers, so
+        the block must never be skipped (same contract as the learner's
+        stack-buffer ring). The wait lands in `ring/recycle_wait_ms`."""
+        t0 = time.monotonic()
+        if pending:
+            jax.block_until_ready(pending)
+        self._m_recycle_ms.observe((time.monotonic() - t0) * 1e3)
+        self.release(s)
+
+    def _recycle_locked(self, s: int) -> None:
+        slot = self._slots[s]
+        slot.gen += 1
+        slot.next_col = 0
+        slot.committed = 0
+        slot.aborted = False
+        self._free.append(s)
+
+    def close(self) -> None:
+        """Wake every blocked acquirer (QueueClosed) and consumer (None)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- startup validation (doctor) --------------------------------------
+
+    def validate_env_spec(
+        self, example_obs: np.ndarray, num_actions: int
+    ) -> List[str]:
+        """Mismatches between the slot buffers and an env spec (empty =
+        ok). The doctor runs this per preset so a shape/dtype drift
+        between config and ring fails at startup, not as garbage batches
+        mid-run."""
+        obs = np.asarray(example_obs)
+        buf = self._slots[0].buffers
+        T, B = self.unroll_length, self.batch_size
+        problems: List[str] = []
+        if buf.obs.shape != (T + 1, B) + obs.shape:
+            problems.append(
+                f"obs slot shape {buf.obs.shape} != expected "
+                f"{(T + 1, B) + obs.shape}"
+            )
+        if buf.obs.dtype != obs.dtype:
+            problems.append(
+                f"obs slot dtype {buf.obs.dtype} != env {obs.dtype}"
+            )
+        if buf.behaviour_logits.shape != (T, B, num_actions):
+            problems.append(
+                f"logits slot shape {buf.behaviour_logits.shape} != "
+                f"expected {(T, B, num_actions)}"
+            )
+        for name, arr, dtype in (
+            ("first", buf.first, np.bool_),
+            ("actions", buf.actions, np.int32),
+            ("behaviour_logits", buf.behaviour_logits, np.float32),
+            ("rewards", buf.rewards, np.float32),
+            ("cont", buf.cont, np.float32),
+            ("task", buf.task, np.int32),
+        ):
+            if arr.dtype != np.dtype(dtype):
+                problems.append(
+                    f"{name} slot dtype {arr.dtype} != {np.dtype(dtype)}"
+                )
+        return problems

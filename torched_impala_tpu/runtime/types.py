@@ -73,6 +73,20 @@ def host_snapshot(tree: Any) -> Any:
     return jax.tree.map(owned, tree)
 
 
+def tree_nbytes(tree: Any) -> int:
+    """Total bytes of the array leaves of a pytree.
+
+    The copy-bytes accounting unit behind `telemetry/learner/
+    host_stack_bytes` (how many bytes the batcher's stacking path copies
+    per batch — the number the zero-copy trajectory ring drives to 0)
+    and bench.py's `traj_ring` section."""
+    return sum(
+        leaf.nbytes
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "nbytes")
+    )
+
+
 def crossed_interval(num_steps: int, delta: int, interval: int) -> bool:
     """True iff advancing the step counter from `num_steps - delta` to
     `num_steps` crossed a multiple of `interval`.

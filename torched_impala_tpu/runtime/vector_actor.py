@@ -42,6 +42,7 @@ import numpy as np
 
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.runtime.traj_ring import TrajectoryRing
 from torched_impala_tpu.runtime.types import (
     QueueClosed,
     Trajectory,
@@ -85,10 +86,17 @@ class VectorActor:
         device: Optional[jax.Device] = None,
         tasks: Optional[Sequence[int]] = None,
         telemetry: Optional[Registry] = None,
+        traj_ring: Optional[TrajectoryRing] = None,
     ) -> None:
         """`tasks` overrides the per-env task ids (default: each env's
         `task_id` attribute, else 0). `device` pins policy inference — see
         `Actor` for the committed-inputs mechanism.
+
+        `traj_ring` switches the unroll to the zero-copy path: every
+        timestep is written straight into a block of E columns of a
+        shared learner batch slot (runtime/traj_ring.py) and `enqueue`
+        is never called — the committed slot IS the batch. The env count
+        must divide the ring's batch_size.
 
         `envs` is either a sequence of gymnasium-API envs (thread path) or
         a single batched-env object exposing
@@ -154,6 +162,36 @@ class VectorActor:
             self._obs = np.stack(obs0)  # [E, ...]
         if len(self._tasks) != E:
             raise ValueError("tasks must have one entry per env")
+        self._ring = traj_ring
+        if traj_ring is not None:
+            # Startup spec check (mirrors doctor's ring check): a
+            # shape/dtype drift between env and ring buffers must fail
+            # here, not as silently garbled batches mid-run.
+            if self._obs.shape[1:] != traj_ring.obs_shape:
+                raise ValueError(
+                    f"traj_ring obs shape {traj_ring.obs_shape} != env "
+                    f"obs shape {self._obs.shape[1:]}"
+                )
+            if self._obs.dtype != traj_ring.obs_dtype:
+                raise ValueError(
+                    f"traj_ring obs dtype {traj_ring.obs_dtype} != env "
+                    f"obs dtype {self._obs.dtype}"
+                )
+            if unroll_length != traj_ring.unroll_length:
+                raise ValueError(
+                    f"traj_ring unroll_length {traj_ring.unroll_length} "
+                    f"!= actor unroll_length {unroll_length}"
+                )
+            if E > traj_ring.batch_size or traj_ring.batch_size % E:
+                raise ValueError(
+                    f"actor env count {E} must divide traj_ring "
+                    f"batch_size {traj_ring.batch_size}"
+                )
+        # Reused [E] scratch the pool's done lane folds into (lockstep
+        # step_all out_dones=); rewards fold straight into the unroll
+        # buffers, but `cont`/`first` are computed FROM dones, so dones
+        # need one stable row outside the trajectory arrays.
+        self._dones_scratch = np.zeros((E,), np.bool_)
         self._first = np.ones((E,), np.bool_)
         self._state = agent.initial_state(E)
         if device is not None:
@@ -180,19 +218,111 @@ class VectorActor:
         self._m_ready_frac.set(ready_frac)
         self._telemetry.heartbeat("actor")
 
-    def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
-        """Step all E envs for T steps; return E single-env trajectories."""
-        if self._pool_async:
-            return self._unroll_async(params, param_version)
-        T, E = self._unroll_length, self.num_envs
-        if self._device is not None:
-            params = jax.device_put(params, self._device)
+    def _unroll_buffers(self, T: int, E: int):
+        """(ring_block, obs, first, actions, rewards, cont, logits).
+
+        Ring mode: the buffers are VIEWS of E columns of a shared learner
+        batch slot — every write below lands directly in the batch the
+        train step will consume (the zero-copy path; acquire blocks on
+        ring backpressure and raises QueueClosed after learner stop).
+        Queue mode: fresh per-unroll arrays that become the E emitted
+        `Trajectory`s; logits allocate lazily (the width is only known
+        after the first inference)."""
+        if self._ring is not None:
+            block = self._ring.acquire(E)
+            return (
+                block,
+                block.obs,
+                block.first,
+                block.actions,
+                block.rewards,
+                block.cont,
+                block.behaviour_logits,
+            )
         obs_buf = np.empty((T + 1, E, *self._obs.shape[1:]), self._obs.dtype)
         first_buf = np.empty((T + 1, E), np.bool_)
         actions = np.empty((T, E), np.int32)
         rewards = np.empty((T, E), np.float32)
         cont = np.empty((T, E), np.float32)
-        logits_buf = None
+        return None, obs_buf, first_buf, actions, rewards, cont, None
+
+    def _finish_unroll(
+        self,
+        block,
+        obs_buf,
+        first_buf,
+        actions,
+        rewards,
+        cont,
+        logits_buf,
+        start_state,
+        param_version: int,
+    ) -> List[Trajectory]:
+        """Commit a ring block (returns []) or slice the unroll buffers
+        into E single-env `Trajectory`s (queue mode)."""
+        if block is not None:
+            block.task[:] = self._tasks
+            if block.agent_state != ():
+                jax.tree.map(
+                    lambda dst, src: np.copyto(dst, np.asarray(src)),
+                    block.agent_state,
+                    start_state,
+                )
+            self._ring.commit(block, param_version)
+            return []
+        return [
+            Trajectory(
+                obs=obs_buf[:, i],
+                first=first_buf[:, i],
+                actions=actions[:, i],
+                behaviour_logits=logits_buf[:, i],
+                rewards=rewards[:, i],
+                cont=cont[:, i],
+                agent_state=jax.tree.map(
+                    lambda x: x[i : i + 1], start_state
+                ),
+                actor_id=self._id,
+                param_version=param_version,
+                task=self._tasks[i],
+            )
+            for i in range(self.num_envs)
+        ]
+
+    def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
+        """Step all E envs for T steps; return E single-env trajectories
+        (an empty list in trajectory-ring mode — the unroll was committed
+        straight into a shared learner batch slot)."""
+        if self._pool_async:
+            return self._unroll_async(params, param_version)
+        T, E = self._unroll_length, self.num_envs
+        if self._device is not None:
+            params = jax.device_put(params, self._device)
+        (
+            block,
+            obs_buf,
+            first_buf,
+            actions,
+            rewards,
+            cont,
+            logits_buf,
+        ) = self._unroll_buffers(T, E)
+        try:
+            return self._unroll_lockstep_body(
+                params, param_version, T, E, block, obs_buf, first_buf,
+                actions, rewards, cont, logits_buf,
+            )
+        except BaseException:
+            # A crashed unroll must not wedge the ring: the reserved
+            # columns hold garbage, so surrender them (the slot recycles
+            # instead of delivering; see TrajectoryRing.abort).
+            if block is not None:
+                self._ring.abort(block)
+            raise
+
+    def _unroll_lockstep_body(
+        self, params, param_version, T, E, block, obs_buf, first_buf,
+        actions, rewards, cont, logits_buf,
+    ) -> List[Trajectory]:
         # host_snapshot, not bare np.asarray: the snapshot outlives
         # self._state (it rides the Trajectory through the learner queue),
         # and an np.asarray VIEW of a dropped jax CPU array can morph when
@@ -227,11 +357,16 @@ class VectorActor:
             if self._pool is not None:
                 # Env stepping happens in the worker processes; the pool
                 # auto-resets finished envs and reports completed episodes.
-                next_obs, step_rewards, dones, events = self._pool.step_all(
-                    acts
-                )
+                # The reward lane folds STRAIGHT into the unroll buffer
+                # row (out_rewards= — in ring mode that row IS the
+                # learner's stacking buffer) and the done lane into the
+                # reused scratch, skipping one copy per step each.
                 actions[t] = acts
-                rewards[t] = step_rewards
+                next_obs, _, dones, events = self._pool.step_all(
+                    acts,
+                    out_rewards=rewards[t],
+                    out_dones=self._dones_scratch,
+                )
                 cont[t] = np.where(dones, 0.0, 1.0)
                 self._obs = next_obs
                 self._first = dones.copy()
@@ -271,23 +406,10 @@ class VectorActor:
         obs_buf[T] = self._obs
         first_buf[T] = self._first
 
-        return [
-            Trajectory(
-                obs=obs_buf[:, i],
-                first=first_buf[:, i],
-                actions=actions[:, i],
-                behaviour_logits=logits_buf[:, i],
-                rewards=rewards[:, i],
-                cont=cont[:, i],
-                agent_state=jax.tree.map(
-                    lambda x: x[i : i + 1], start_state
-                ),
-                actor_id=self._id,
-                param_version=param_version,
-                task=self._tasks[i],
-            )
-            for i in range(E)
-        ]
+        return self._finish_unroll(
+            block, obs_buf, first_buf, actions, rewards, cont,
+            logits_buf, start_state, param_version,
+        )
 
     def _unroll_async(self, params, param_version: int) -> List[Trajectory]:
         """Ready-set unroll against an async `ProcessEnvPool`.
@@ -309,12 +431,30 @@ class VectorActor:
         wave_k = max(1, math.ceil(pool.ready_fraction * W))
         if self._device is not None:
             params = jax.device_put(params, self._device)
-        obs_buf = np.empty((T + 1, E, *self._obs.shape[1:]), self._obs.dtype)
-        first_buf = np.empty((T + 1, E), np.bool_)
-        actions = np.empty((T, E), np.int32)
-        rewards = np.empty((T, E), np.float32)
-        cont = np.empty((T, E), np.float32)
-        logits_buf = None
+        (
+            block,
+            obs_buf,
+            first_buf,
+            actions,
+            rewards,
+            cont,
+            logits_buf,
+        ) = self._unroll_buffers(T, E)
+        try:
+            return self._unroll_async_body(
+                params, param_version, T, E, W, Ew, wave_k, block,
+                obs_buf, first_buf, actions, rewards, cont, logits_buf,
+            )
+        except BaseException:
+            if block is not None:
+                self._ring.abort(block)
+            raise
+
+    def _unroll_async_body(
+        self, params, param_version, T, E, W, Ew, wave_k, block,
+        obs_buf, first_buf, actions, rewards, cont, logits_buf,
+    ) -> List[Trajectory]:
+        pool = self._pool
         start_state = host_snapshot(self._state)
         obs_buf[0] = self._obs
         first_buf[0] = self._first
@@ -367,7 +507,12 @@ class VectorActor:
             # ready — never for the whole pool.
             target = min(wave_k, W - completed)
             while len(actionable) < target:
-                for w, rw, dn, events, _ok in pool.wait_any():
+                # copy=False: rewards/dones arrive as shm-lane views and
+                # advance() copies them once, straight into the unroll
+                # (ring) buffers — the lane fold skipping the per-ack
+                # intermediate copy. Views stay valid until the worker's
+                # next submit, which only happens after advance() ran.
+                for w, rw, dn, events, _ok in pool.wait_any(copy=False):
                     advance(w, rw, dn, events)
                 target = min(wave_k, W - completed)
             # Grace window: once the ready fraction is met, wait one short
@@ -385,13 +530,15 @@ class VectorActor:
                     budget = deadline - time.monotonic()
                     if budget <= 0:
                         break
-                    acks = pool.wait_any(timeout=budget)
+                    acks = pool.wait_any(timeout=budget, copy=False)
                     if not acks:
                         break
                     for w, rw, dn, events, _ok in acks:
                         advance(w, rw, dn, events)
             else:
-                for w, rw, dn, events, _ok in pool.wait_any(timeout=0):
+                for w, rw, dn, events, _ok in pool.wait_any(
+                    timeout=0, copy=False
+                ):
                     advance(w, rw, dn, events)
             remaining = W - completed
             if remaining == 0:
@@ -453,28 +600,22 @@ class VectorActor:
             # with stragglers catching up elsewhere).
             self._record_wave(wave_t0, len(rows), take / remaining)
 
-        return [
-            Trajectory(
-                obs=obs_buf[:, i],
-                first=first_buf[:, i],
-                actions=actions[:, i],
-                behaviour_logits=logits_buf[:, i],
-                rewards=rewards[:, i],
-                cont=cont[:, i],
-                agent_state=jax.tree.map(
-                    lambda x: x[i : i + 1], start_state
-                ),
-                actor_id=self._id,
-                param_version=param_version,
-                task=self._tasks[i],
-            )
-            for i in range(E)
-        ]
+        return self._finish_unroll(
+            block, obs_buf, first_buf, actions, rewards, cont,
+            logits_buf, start_state, param_version,
+        )
 
     def unroll_and_push(self) -> None:
         version, params = self._param_store.get()
         with self._m_unroll.time():
             trajs = self.unroll(params, version)
+        if self._ring is not None:
+            # The unroll was committed into the ring in place — no
+            # Trajectory objects, no enqueue. Same accounting surface:
+            # one cycle still produced E unrolls.
+            self.num_unrolls += self.num_envs
+            self._m_unrolls.inc(self.num_envs)
+            return
         for traj in trajs:
             self._enqueue(traj)
             self.num_unrolls += 1
