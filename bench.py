@@ -280,6 +280,9 @@ def main(args) -> None:
     # Host-side: telemetry registry overhead on the env-pool hot path
     # (ISSUE 2 acceptance: < 2% of env-pool steps/s with telemetry on).
     section("telemetry", lambda: run_bench_telemetry(jax))
+    # Host-side: flight-recorder overhead on the same hot path (ISSUE 4
+    # acceptance: < 1% with tracing always on) + raw record-op ns.
+    section("tracing", lambda: run_bench_tracing(jax))
     # Host-side: zero-copy trajectory ring vs the queue path (ISSUE 3
     # acceptance: host_stack span + per-unroll enqueue copy bytes drop,
     # batches bit-identical on fixed seeds).
@@ -1240,10 +1243,10 @@ def run_feeder_saturation(jax, tpu_ok: bool) -> dict:
                 # Pull assembled device batches off the bounded queue with
                 # no train step: host queue -> stacking -> device_put is
                 # the whole measured path.
-                arrays, _ = learner._batch_q.get(timeout=600)  # warmup
+                arrays, _, _ = learner._batch_q.get(timeout=600)  # warmup
                 t0 = time.perf_counter()
                 for _ in range(steps):
-                    arrays, _ = learner._batch_q.get(timeout=600)
+                    arrays, _, _ = learner._batch_q.get(timeout=600)
                 jax.block_until_ready(jax.tree.leaves(arrays)[0])
                 dt = time.perf_counter() - t0
                 wait_frac = None
@@ -1622,6 +1625,155 @@ def run_bench_telemetry(jax) -> dict:
     return out
 
 
+def run_bench_tracing(jax, tiny: bool = False) -> dict:
+    """Flight-recorder overhead (ISSUE 4 acceptance: < 1% on the async
+    env-pool loop with tracing always on).
+
+    Two measurements, mirroring the telemetry section's protocol:
+    1. raw per-record cost (ns/op, single thread) of each record kind —
+       instant, pre-timed complete, span context manager — plus the
+       export cost per retained event;
+    2. env-steps/s through the instrumented VectorActor+ProcessEnvPool
+       pipeline with the global recorder ENABLED vs DISABLED
+       (`set_trace_enabled`) — the end-to-end bound. The recorder is
+       always on in production, so the "off" arm exists only to price
+       the "on" arm.
+
+    `tiny=True` shrinks the op counts and unroll count for the CI bound
+    in tests/test_bench_units.py (same code path, looser assert)."""
+    import numpy as np
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.envs.fake import StragglerFactory
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
+    from torched_impala_tpu.runtime.param_store import ParamStore
+    from torched_impala_tpu.runtime.vector_actor import VectorActor
+    from torched_impala_tpu.telemetry import (
+        FlightRecorder,
+        get_recorder,
+        set_trace_enabled,
+    )
+
+    # 1. raw per-op costs on a fresh recorder (same ring capacity as the
+    # global one — overwrite cost is part of the steady state).
+    rec = FlightRecorder()
+    lineage = {"lid": "a0u0", "worker": 3}
+    N = 20_000 if tiny else 200_000
+    t_ns = time.monotonic_ns()
+
+    def timed(op) -> float:
+        t0 = time.perf_counter()
+        for _ in range(N):
+            op()
+        return round((time.perf_counter() - t0) / N * 1e9, 1)
+
+    raw_ns = {
+        "instant": timed(lambda: rec.instant("bench/evt", lineage)),
+        "complete": timed(
+            lambda: rec.complete("bench/span", t_ns, 1000, lineage)
+        ),
+        "span_ctx": timed(
+            lambda: rec.span("bench/ctx", lineage).__enter__()
+            .__exit__(None, None, None)
+        ),
+        "instant_no_lineage": timed(lambda: rec.instant("bench/bare")),
+    }
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench_trace.json")
+        t0 = time.perf_counter()
+        n_events = rec.export(path)
+        raw_ns["export_us_per_event"] = round(
+            (time.perf_counter() - t0) / max(1, n_events) * 1e6, 2
+        )
+        raw_ns["export_events"] = n_events
+    log(f"bench: tracing raw ops: {raw_ns}")
+
+    # 2. end-to-end env-pool throughput, recorder on vs off (identical
+    # harness to the telemetry section: 1ms base delay, no stragglers).
+    W, E, T = 4, 4, 20
+    unrolls = 2 if tiny else 3
+    inner = configs.make_env_factory(
+        configs.ExperimentConfig(
+            name="bench_tracing",
+            env_family="cartpole",
+            obs_shape=(8,),
+            num_actions=4,
+        ),
+        fake=True,
+    )
+    factory = StragglerFactory(
+        inner, base_delay_s=1e-3, straggler_delay_s=0.0, straggler_prob=0.0
+    )
+    agent = Agent(
+        ImpalaNet(num_actions=4, torso=MLPTorso(hidden_sizes=(64,)))
+    )
+    params = agent.init_params(
+        jax.random.key(0), np.zeros((8,), np.float32)
+    )
+    store = ParamStore()
+    store.publish(0, params)
+    try:
+        device = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        device = None
+
+    def measure(enabled: bool) -> float:
+        set_trace_enabled(enabled)
+        pool = ProcessEnvPool(
+            env_factory=factory,
+            num_workers=W,
+            envs_per_worker=E,
+            obs_shape=(8,),
+            obs_dtype=np.float32,
+            mode="async",
+            ready_fraction=0.5,
+        )
+        try:
+            actor = VectorActor(
+                actor_id=0,
+                envs=pool,
+                agent=agent,
+                param_store=store,
+                enqueue=lambda t: None,
+                unroll_length=T,
+                seed=0,
+                device=device,
+            )
+            actor.unroll_and_push()  # warmup: compiles wave shapes
+            t0 = time.perf_counter()
+            for _ in range(unrolls):
+                actor.unroll_and_push()
+            dt = time.perf_counter() - t0
+            return unrolls * T * pool.num_envs / dt
+        finally:
+            pool.close()
+            set_trace_enabled(True)
+
+    # Interleaved arms, best-of-3 (max filters OS scheduling noise on a
+    # loaded box — same rationale as the telemetry section).
+    on, off = [], []
+    for _ in range(3):
+        on.append(measure(True))
+        off.append(measure(False))
+    sps_on, sps_off = max(on), max(off)
+    out = {
+        "raw_ns_per_op": raw_ns,
+        "recorder_capacity": get_recorder().capacity,
+        "pool": f"{W}x{E} envs, T={T}, async, 1ms base delay",
+        "env_steps_per_sec_on": round(sps_on, 1),
+        "env_steps_per_sec_off": round(sps_off, 1),
+        "overhead_pct": round((1.0 - sps_on / sps_off) * 100.0, 2),
+    }
+    log(f"bench: tracing overhead: {out['overhead_pct']}% "
+        f"(on {out['env_steps_per_sec_on']} vs off "
+        f"{out['env_steps_per_sec_off']} steps/s)")
+    return out
+
+
 def run_bench_traj_ring(jax, tiny: bool = False) -> dict:
     """Zero-copy trajectory ring vs the queue path (ISSUE 3 tentpole):
     one VectorActor over fake Pong envs (84x84x4 uint8) feeding the real
@@ -1709,7 +1861,7 @@ def run_bench_traj_ring(jax, tiny: bool = False) -> dict:
             for _ in range(n_batches):
                 for _ in range(B // E):
                     actor.unroll_and_push()
-                arrays, _ = learner._batch_q.get(timeout=300)
+                arrays, _, _ = learner._batch_q.get(timeout=300)
                 # Owning copies: queued device arrays on the CPU backend
                 # can be views whose buffers the allocator later reuses.
                 batches.append(

@@ -97,3 +97,22 @@ def test_traj_ring_bench_overhead_bound(jax_cpu):
         <= q["stack_copy_bytes_per_unroll"]
     ), out
     assert r["host_stack_ms"] < q["host_stack_ms"], out
+
+
+def test_tracing_bench_overhead_bound(jax_cpu):
+    """The ISSUE 4 acceptance bound, wired into CI via the bench
+    section's tiny variant: the flight recorder stays negligible with
+    tracing always on. The bench artifact pins < 1% on this box
+    (measured 0.1-0.3%); the CI asserts keep slack for scheduling noise on
+    a loaded runner — raw record ops must stay in the microsecond
+    class (measured ~0.6-1.4 us) and the end-to-end env-pool overhead
+    far below the point where "always on" would be a lie."""
+    from bench import run_bench_tracing
+
+    out = run_bench_tracing(jax_cpu, tiny=True)
+    raw = out["raw_ns_per_op"]
+    for op in ("instant", "complete", "span_ctx"):
+        assert raw[op] < 50_000, (op, raw)  # 50 us: pure-noise ceiling
+    # The export really saw the ring's retained records.
+    assert raw["export_events"] > 0, raw
+    assert out["overhead_pct"] < 10.0, out

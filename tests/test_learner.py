@@ -552,7 +552,7 @@ class TestStackBufferReuse:
         drained = []
         try:
             for _ in range(n_batches):
-                arrays, _ = learner._batch_q.get(timeout=60)
+                arrays, _, _ = learner._batch_q.get(timeout=60)
                 # Copy to host IMMEDIATELY, and FORCE the copy:
                 # np.asarray of a jax CPU array can be a zero-copy VIEW
                 # of the device buffer, which dangles once jax frees the

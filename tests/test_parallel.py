@@ -133,7 +133,7 @@ def test_batch_lands_sharded_over_data_axis():
     for t in trajs:
         learner.enqueue(t)
     learner.start()
-    (arrays, _version) = learner._batch_q.get(timeout=60)
+    (arrays, _version, _meta) = learner._batch_q.get(timeout=60)
     learner.stop()
     obs = arrays[0]
     assert obs.shape == (T + 1, B, 4)

@@ -60,8 +60,55 @@ def test_histogram_bucket_edges():
     assert snap["telemetry/test/lat_ms_mean"] == pytest.approx(17.0 / 6)
     # p50: rank 3 of 6 falls at the top of bucket 2 (upper edge 2.0).
     assert 1.0 <= snap["telemetry/test/lat_ms_p50"] <= 2.0
-    # p95: rank 5.7 of 6 falls in the +inf bucket, which reports max.
+    # p95/p99: ranks 5.7 and 5.94 of 6 fall in the +inf bucket, which
+    # reports max.
     assert snap["telemetry/test/lat_ms_p95"] == 7.0
+    assert snap["telemetry/test/lat_ms_p99"] == 7.0
+
+
+def test_histogram_quantile_ordering_and_interpolation():
+    """p50 <= p95 <= p99 <= max, each linearly interpolated inside its
+    bucket when the rank lands below the +inf tail."""
+    reg = Registry()
+    h = reg.histogram("test/quant_ms", buckets=(10.0, 100.0, 1000.0))
+    for _ in range(98):
+        h.observe(5.0)  # bucket [0, 10]
+    h.observe(500.0)  # bucket (100, 1000]
+    h.observe(500.0)
+    snap = reg.snapshot()
+    p50 = snap["telemetry/test/quant_ms_p50"]
+    p95 = snap["telemetry/test/quant_ms_p95"]
+    p99 = snap["telemetry/test/quant_ms_p99"]
+    assert 0.0 < p50 <= 10.0
+    assert 0.0 < p95 <= 10.0  # rank 95 of 100 still in the first bucket
+    # rank 99 of 100 lands in the (100, 1000] bucket: interpolated
+    # there, clamped to the observed max (no real quantile exceeds it).
+    assert 100.0 <= p99 <= 500.0
+    assert p50 <= p95 <= p99 <= snap["telemetry/test/quant_ms_max"]
+
+
+def test_histogram_single_bucket_edge_case():
+    """One configured edge: two real buckets ([0, e] and +inf). The
+    quantile estimator must interpolate in the only finite bucket and
+    report the observed max from the tail — not crash or divide by a
+    missing lower edge."""
+    reg = Registry()
+    h = reg.histogram("test/single_ms", buckets=(5.0,))
+    h.observe(1.0)
+    h.observe(6.0)  # +inf tail
+    snap = reg.snapshot()
+    assert snap["telemetry/test/single_ms_count"] == 2
+    # rank 1 of 2: top of the finite bucket, interpolated within [0, 5].
+    assert 0.0 < snap["telemetry/test/single_ms_p50"] <= 5.0
+    # ranks 1.9/1.98 of 2: the +inf bucket reports the max.
+    assert snap["telemetry/test/single_ms_p95"] == 6.0
+    assert snap["telemetry/test/single_ms_p99"] == 6.0
+    # All observations in the single finite bucket: quantiles stay
+    # inside it.
+    h2 = reg.histogram("test/single2_ms", buckets=(5.0,))
+    h2.observe(2.0)
+    snap = reg.snapshot()
+    assert 0.0 < snap["telemetry/test/single2_ms_p99"] <= 5.0
 
 
 def test_histogram_empty_is_nan_not_crash():
@@ -70,6 +117,7 @@ def test_histogram_empty_is_nan_not_crash():
     snap = reg.snapshot()
     assert snap["telemetry/test/empty_ms_count"] == 0
     assert math.isnan(snap["telemetry/test/empty_ms_p95"])
+    assert math.isnan(snap["telemetry/test/empty_ms_p99"])
     assert math.isnan(snap["telemetry/test/empty_ms_mean"])
 
 
@@ -376,12 +424,16 @@ def test_metric_name_lint_catches_violations(tmp_path):
         'x = "telemetry/bad key here"\n'  # prose, must NOT flag
         'y = "telemetry/bad/Key"\n'  # malformed literal, not flagged
         'z = "telemetry/ok/key"\n'
+        'rec.instant("Bad.Trace")\n'  # trace grammar violation
+        'rec.complete("pool/worker_step", t0, dur)\n'  # valid trace
+        'rec.instant("ring/commit", {"lid": lid})\n'  # valid trace
     )
     errors = lint.check(str(tmp_path))
     joined = "\n".join(errors)
     assert "NoSlash" in joined
     assert "registered it as gauge" in joined
-    assert len(errors) == 2
+    assert "Bad.Trace" in joined and "trace instant" in joined
+    assert len(errors) == 3
 
 
 # ---- pipeline integration ----------------------------------------------

@@ -181,7 +181,7 @@ class TestRingPipeline:
             for _ in range(n):
                 for _ in range(B // E):
                     actor.unroll_and_push()
-                arrays, version = learner._batch_q.get(timeout=60)
+                arrays, version, _meta = learner._batch_q.get(timeout=60)
                 batches.append(
                     (
                         jax.tree.map(

@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""Lint telemetry metric names across the codebase (ISSUE 2 satellite).
+"""Lint telemetry metric AND trace event names across the codebase
+(ISSUE 2 satellite; trace grammar added by ISSUE 4).
 
 Statically scans `torched_impala_tpu/**/*.py` (and `bench.py`) for
 telemetry registration call sites — `.counter("...")`, `.gauge("...")`,
-`.timer("...")`, `.histogram("...")`, `.span("...")` — and for literal
-emitted keys (`"telemetry/..."` strings and `f"{PREFIX}/..."`
-interpolations), then asserts:
+`.timer("...")`, `.histogram("...")`, `.span("...")` — flight-recorder
+event call sites — `.instant("...")`, `.begin("...")`, `.end("...")`,
+`.complete("...")` (telemetry/tracing.py) — and for literal emitted
+keys (`"telemetry/..."` strings and `f"{PREFIX}/..."` interpolations),
+then asserts:
 
 1. every registered name matches the `<component>/<name>` slug grammar
    (so every emitted key matches `telemetry/<component>/<name>[_suffix]`);
@@ -13,7 +16,14 @@ interpolations), then asserts:
    (a `span` counts as its backing `timer`) — a type fork would silently
    split one series into two;
 3. every literal emitted key carries the `telemetry/` prefix and the same
-   grammar.
+   grammar;
+4. every trace event name follows the SAME `<component>/<name>` grammar
+   (the recorder enforces it at runtime too; trace components map to
+   Chrome-trace process rows, so a malformed name breaks the Perfetto
+   grouping). Trace phases are not types: the same name may appear as
+   instant and complete — only recorder-vs-METRIC grammar is shared,
+   `.span("...")` sites (registry or recorder) both count as the timer
+   series by design.
 
 Static on purpose: the lint runs from the test suite
 (tests/test_telemetry.py) on every CI pass without spawning pools or
@@ -37,6 +47,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # .counter("pool/restarts") / reg.span('learner/train_step') ...
 _REG_CALL = re.compile(
     r"\.(counter|gauge|timer|histogram|span)\(\s*([\"'])([^\"']+)\2"
+)
+# Flight-recorder event sites: tracer.instant("ring/commit", ...),
+# tracer.complete("pool/worker_step", ...). Same slug grammar, no type
+# semantics (phases may mix freely on one name).
+_TRACE_CALL = re.compile(
+    r"\.(instant|begin|end|complete)\(\s*([\"'])([^\"']+)\2"
 )
 # Literal emitted keys: a quoted string that IS a key ("telemetry/...",
 # nothing else inside the quotes — prose mentioning keys is skipped) or
@@ -69,12 +85,15 @@ def check(root: str = REPO) -> List[str]:
     errors: List[str] = []
     # name -> (canonical kind, first site)
     seen: Dict[str, Tuple[str, str]] = {}
+    machinery = {
+        # These define the machinery; their docstring examples would
+        # read as registrations/events.
+        os.path.join("torched_impala_tpu", "telemetry", "registry.py"),
+        os.path.join("torched_impala_tpu", "telemetry", "tracing.py"),
+    }
     for path in sorted(_py_files(root)):
         rel = os.path.relpath(path, root)
-        if rel == os.path.join("torched_impala_tpu", "telemetry",
-                               "registry.py"):
-            # The registry itself only defines the machinery; its
-            # docstring examples would read as registrations.
+        if rel in machinery:
             continue
         with open(path, encoding="utf-8") as f:
             for lineno, line in enumerate(f, 1):
@@ -95,6 +114,13 @@ def check(root: str = REPO) -> List[str]:
                         errors.append(
                             f"{site}: {name!r} registered as {kind} "
                             f"but {prev[1]} registered it as {prev[0]}"
+                        )
+                for kind, _q, name in _TRACE_CALL.findall(line):
+                    if not NAME_RE.match(name):
+                        errors.append(
+                            f"{site}: trace {kind} name {name!r} does "
+                            f"not match <component>/<name> "
+                            f"({NAME_RE.pattern})"
                         )
                 for m in _LITERAL_KEY.finditer(line):
                     if not NAME_RE.match(m.group(1)):

@@ -151,6 +151,13 @@ class ExperimentConfig:
     # into a same-minute stack dump.
     telemetry_interval: int = 1
     stall_timeout_s: float = 300.0
+    # Flight-recorder export (telemetry/tracing.py): write the retained
+    # trace events — per-unroll lineage IDs threaded env→pool→queue/
+    # ring→learner with exact per-batch param lag — as Chrome-trace
+    # JSON at this path when the run ends ("" = no export; the recorder
+    # itself is always on, and SIGUSR2 dumps it on demand). run.py's
+    # `--trace out.json` overrides per run.
+    trace_path: str = ""
     # Parallelism: shard the learner batch over this many devices (DP);
     # 0 = single device. SURVEY.md §3b DP row.
     dp_devices: int = 0

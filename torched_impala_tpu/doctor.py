@@ -9,6 +9,9 @@ Checks, in order:
    deepmind_lab optional — reported MISSING, not failed);
 2. accelerator: jax backend init + one tiny jit (bounded by the caller's
    --platform choice; a wedged TPU tunnel surfaces here, not mid-run);
+   then telemetry registry, flight-recorder trace round-trip (a 2-event
+   Chrome-trace export under traces/ reloaded + schema-validated), and
+   trajectory-ring spec checks;
 3. per-family env contract: construct the REAL factory, reset, step a
    random policy N steps, validate the (obs, reward, terminated,
    truncated, info) surface, dtypes and shapes against the factory's
@@ -165,6 +168,50 @@ def _check_telemetry() -> tuple[str, str]:
         return "FAIL", f"telemetry stack broken:\n{traceback.format_exc()}"
 
 
+def _check_tracing() -> tuple[str, str]:
+    """Flight-recorder self-check: record a 2-event trace (one span, one
+    instant with a lineage ID), export it under `traces/`, reload the
+    JSON, and validate the Chrome-trace schema — so `--trace` / SIGUSR2
+    dumps are known-loadable in Perfetto BEFORE a long run depends on
+    them. Purely local; the file is left behind as a sample trace."""
+    import json
+    import os
+
+    from torched_impala_tpu.telemetry import (
+        FlightRecorder,
+        validate_chrome_trace,
+    )
+
+    try:
+        rec = FlightRecorder(capacity=64)
+        with rec.span("doctor/selfcheck", {"lid": "a0u0"}):
+            pass
+        rec.instant("doctor/event", {"lid": "a0u0"})
+        assert len(rec) == 2, len(rec)
+        path = os.path.join("traces", "doctor_trace.json")
+        n = rec.export(path)
+        assert n == 2, n
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+        problems = validate_chrome_trace(obj)
+        if problems:
+            return "FAIL", (
+                "exported trace violates the Chrome-trace schema: "
+                + "; ".join(problems)
+            )
+        events = [e for e in obj["traceEvents"] if e["ph"] != "M"]
+        names = {e["name"] for e in events}
+        assert names == {"doctor/selfcheck", "doctor/event"}, names
+        assert all(e.get("args", {}).get("lid") == "a0u0"
+                   for e in events), events
+        return "ok", (
+            f"2-event trace round-trips through {path} "
+            "(schema valid, lineage args intact)"
+        )
+    except Exception:
+        return "FAIL", f"flight recorder broken:\n{traceback.format_exc()}"
+
+
 def _check_traj_ring() -> tuple[str, str]:
     """Validate the zero-copy trajectory ring against real preset env
     specs: slot dtypes/shapes must match what the preset's envs emit
@@ -309,6 +356,9 @@ def run_doctor(config_name: str | None = None) -> int:
     status, detail = _check_telemetry()
     print(f"  telemetry  [{status}] {detail}")
     failed = status == "FAIL"
+    status, detail = _check_tracing()
+    print(f"  tracing    [{status}] {detail}")
+    failed |= status == "FAIL"
     status, detail = _check_traj_ring()
     print(f"  traj ring  [{status}] {detail}")
     failed |= status == "FAIL"

@@ -161,7 +161,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "written under --trace-dir (telemetry/profiling.py)")
     p.add_argument("--trace-dir", default="traces",
                    help="directory for --profile-steps / SIGUSR1 trace "
-                        "captures (one subdirectory per capture)")
+                        "captures (one subdirectory per capture) and "
+                        "SIGUSR2 flight-recorder dumps")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="export the flight recorder (telemetry/"
+                        "tracing.py: per-unroll lineage env→pool→queue/"
+                        "ring→learner, exact per-batch param lag) as "
+                        "Chrome-trace JSON at run end; load in Perfetto "
+                        "(docs/OBSERVABILITY.md). SIGUSR2 dumps the "
+                        "recorder on a live run regardless of this flag")
     # Observability (telemetry/, docs/OBSERVABILITY.md). SIGUSR1 on a
     # live train run toggles a profiler capture into --trace-dir.
     p.add_argument("--telemetry-every", type=int, default=None,
@@ -204,6 +212,7 @@ def build_config(args: argparse.Namespace):
         ("transformer_attention", "transformer_attention"),
         ("transformer_dtype", "transformer_dtype"),
         ("env_id", "env_id"),
+        ("trace", "trace_path"),
     ):
         v = getattr(args, flag)
         if v is not None:
@@ -233,16 +242,19 @@ def build_config(args: argparse.Namespace):
 def make_profiler(args: argparse.Namespace):
     """(capture, window) for on-demand jax.profiler traces: SIGUSR1 on
     the live process toggles a capture into --trace-dir (best-effort
-    install), and --profile-steps A:B drives a bounded learner-step
-    window. `window` is None without --profile-steps."""
+    install), SIGUSR2 dumps the flight recorder there, and
+    --profile-steps A:B drives a bounded learner-step window. `window`
+    is None without --profile-steps."""
     from torched_impala_tpu.telemetry import (
         ProfilerCapture,
         StepWindowProfiler,
+        install_sigusr2,
         parse_profile_steps,
     )
 
     capture = ProfilerCapture(args.trace_dir)
     capture.install_sigusr1()
+    install_sigusr2(args.trace_dir)
     window = None
     if args.profile_steps:
         try:
@@ -469,6 +481,7 @@ def main(argv=None) -> int:
             on_learner_step=(
                 profile_window.on_step if profile_window else None
             ),
+            trace_path=cfg.trace_path or None,
         )
     finally:
         if profile_window is not None:
@@ -598,6 +611,18 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
             if checkpointer.latest_step() != runner.num_steps:
                 checkpointer.save(runner.num_steps, runner.get_state())
             checkpointer.close()
+        if cfg.trace_path:
+            # Anakin records no host lineage (rollouts fuse into the XLA
+            # program), but whatever reached the recorder still exports.
+            from torched_impala_tpu.telemetry import get_recorder
+
+            try:
+                get_recorder().export(cfg.trace_path)
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                print(
+                    f"[flight-recorder] export failed: {e!r}",
+                    file=sys.stderr,
+                )
         logger.close()
     jax.block_until_ready(jax.tree.leaves(runner.params)[0])
     dt = time.perf_counter() - t0

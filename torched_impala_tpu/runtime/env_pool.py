@@ -80,6 +80,10 @@ import numpy as np
 # measured from the parent's submit->ack edge, so worker processes stay
 # numpy-only and never share metric locks across the fork).
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+)
 
 try:
     _CTX = mp.get_context("forkserver")
@@ -253,6 +257,7 @@ class ProcessEnvPool:
         mode: str = "lockstep",
         ready_fraction: float = 0.5,
         telemetry: Optional[Registry] = None,
+        tracer: Optional[FlightRecorder] = None,
     ) -> None:
         if num_workers < 1 or envs_per_worker < 1:
             raise ValueError("need >= 1 worker and >= 1 env per worker")
@@ -331,6 +336,14 @@ class ProcessEnvPool:
         self._m_ready_fraction.set(self.ready_fraction)
         self._submit_t = [0.0] * num_workers
         self._step_ewma: Optional[float] = None
+        # Flight recorder (telemetry/tracing.py): every parent-observed
+        # submit->ack edge becomes a `pool/worker_step` span tagged with
+        # `trace_lineage` — the lineage ID of the unroll the driving
+        # VectorActor is currently filling (the actor sets it at each
+        # unroll start), so a trace ties every env step to the batch
+        # that eventually consumes it.
+        self._tracer = tracer if tracer is not None else get_recorder()
+        self.trace_lineage = ""
 
         n = num_workers * envs_per_worker
         obs_bytes = n * int(np.prod(self._obs_shape)) * self._obs_dtype.itemsize
@@ -463,6 +476,12 @@ class ProcessEnvPool:
         self._submit_t[w] = 0.0
         dur = time.monotonic() - t0
         self._m_step_ms.observe(dur * 1e3)
+        self._tracer.complete(
+            "pool/worker_step",
+            int(t0 * 1e9),
+            int(dur * 1e9),
+            {"lid": self.trace_lineage, "worker": w},
+        )
         ewma = self._step_ewma
         is_straggler = False
         if ewma is None:

@@ -24,7 +24,7 @@ import queue
 import threading
 import time
 import weakref
-from typing import Any, Callable, Mapping, Optional
+from typing import Any, Callable, Mapping, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,10 @@ from torched_impala_tpu.parallel import multihost
 from torched_impala_tpu.runtime.param_store import ParamStore
 from torched_impala_tpu.runtime.traj_ring import TrajectoryRing
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+)
 from torched_impala_tpu.runtime.types import (
     QueueClosed,
     Trajectory,
@@ -154,6 +158,19 @@ class LearnerConfig:
     data_device: Optional[str] = None
 
 
+class BatchLineage(NamedTuple):
+    """Provenance of one assembled batch, riding the device queue next
+    to the arrays: `batch` is the batcher's sequence number, `lineage`
+    the consumed unrolls' flight-recorder IDs (column order), `versions`
+    their param versions — the inputs of the EXACT per-batch staleness
+    the train-step trace span reports (the `learner/param_lag_frames`
+    gauge is the min-version summary of the same numbers)."""
+
+    batch: int
+    lineage: tuple = ()
+    versions: tuple = ()
+
+
 def _put_format(x, fmt):
     """device_put into an XLA-chosen Format; leaves whose format carries
     no concrete layout (scalars/empty subtrees) take the default put.
@@ -224,7 +241,8 @@ def stack_trajectories(
             )
         out.task[...] = [t.task for t in trajs]
         return out._replace(
-            param_version=min(t.param_version for t in trajs)
+            param_version=min(t.param_version for t in trajs),
+            lineage_id=tuple(t.lineage_id for t in trajs),
         )
     batched = Trajectory(
         obs=np.stack([t.obs for t in trajs], axis=1),
@@ -244,6 +262,7 @@ def stack_trajectories(
         actor_id=-1,
         param_version=min(t.param_version for t in trajs),
         task=np.asarray([t.task for t in trajs], np.int32),
+        lineage_id=tuple(t.lineage_id for t in trajs),
     )
     return batched
 
@@ -318,6 +337,7 @@ class Learner:
         logger: Optional[Callable[[Mapping[str, Any]], None]] = None,
         mesh: Optional[Mesh] = None,
         telemetry: Optional[Registry] = None,
+        tracer: Optional[FlightRecorder] = None,
     ) -> None:
         """`mesh=None` → single-device jit; `mesh=Mesh(..., ('data','model'))`
         → batch sharded over `data` (gradient all-reduce inserted by the
@@ -481,6 +501,14 @@ class Learner:
         # global registry (benchmarks isolate runs with fresh ones).
         reg = telemetry if telemetry is not None else get_registry()
         self._telemetry = reg
+        # Flight recorder (telemetry/tracing.py): the batcher stamps a
+        # monotone batch id on every assembled batch and the stage spans
+        # (host_stack / device_put / train_step / publish) carry it plus
+        # the consumed unrolls' lineage IDs — the per-batch half of the
+        # observability story; the registry below is the aggregate half.
+        self._tracer = tracer if tracer is not None else get_recorder()
+        self._batch_seq = 0
+        self._last_lineage = BatchLineage(batch=-1)
         self._m_host_stack = reg.timer("learner/host_stack")
         # Bytes the stacking path COPIES per batch (the number the
         # trajectory ring drives to 0) and, ring mode only, bytes staged
@@ -535,6 +563,7 @@ class Learner:
                 num_actions=agent.net.num_actions,
                 agent_state_example=agent.initial_state(1),
                 telemetry=reg,
+                tracer=self._tracer,
             )
 
         self.param_store = ParamStore()
@@ -856,11 +885,18 @@ class Learner:
                 raise QueueClosed()
             try:
                 self._traj_q.put(traj, timeout=0.5)
+                now = time.monotonic()
                 # Time spent blocked on a full queue: ~0 means the learner
                 # keeps up; growing p95 means actors outrun it (the
                 # backpressure diagnostic, ISSUE 2 queue row).
-                self._m_enqueue_block.observe(
-                    (time.monotonic() - t0) * 1e3
+                self._m_enqueue_block.observe((now - t0) * 1e3)
+                # The queue hop of the lineage chain: the span duration
+                # IS the backpressure this unroll paid to get in.
+                self._tracer.complete(
+                    "queue/enqueue",
+                    int(t0 * 1e9),
+                    int((now - t0) * 1e9),
+                    {"lid": traj.lineage_id},
                 )
                 return
             except queue.Full:
@@ -1051,12 +1087,36 @@ class Learner:
                 return
         self._ring_pending[slot] = leaves
 
+    def _next_batch_lineage(self, lineage, versions) -> BatchLineage:
+        """Stamp the next batch id on the consumed unrolls' provenance
+        (batcher thread only — the sequence needs no lock)."""
+        bid = self._batch_seq
+        self._batch_seq += 1
+        meta = BatchLineage(
+            batch=bid,
+            lineage=tuple(lineage),
+            versions=tuple(int(v) for v in versions),
+        )
+        self._last_lineage = meta
+        return meta
+
     def _assemble_batch(self) -> Optional[Trajectory]:
         trajs = self._collect_trajs()
         if trajs is None:
             return None
+        meta = self._next_batch_lineage(
+            (t.lineage_id for t in trajs),
+            (t.param_version for t in trajs),
+        )
+        t0_ns = time.monotonic_ns()
         with self._m_host_stack.time():
             batch = stack_trajectories(trajs, out=self._stack_out(trajs))
+        self._tracer.complete(
+            "learner/host_stack",
+            t0_ns,
+            time.monotonic_ns() - t0_ns,
+            {"batch": meta.batch, "lineage": list(meta.lineage)},
+        )
         self._count_stack_bytes(batch)
         return batch
 
@@ -1086,10 +1146,16 @@ class Learner:
         from the first round's trajectories."""
         sb: Optional[Trajectory] = None
         versions = []
+        lids: list = []
+        unroll_versions: list = []
         for k in range(K):
             trajs = self._collect_trajs()
             if trajs is None:
                 return None
+            lids.extend(t.lineage_id for t in trajs)
+            unroll_versions.extend(
+                int(t.param_version) for t in trajs
+            )
             if sb is None:
                 sb = self._stack_out(trajs, K)
                 if sb is None:  # reuse off: fresh allocation
@@ -1111,6 +1177,7 @@ class Learner:
                     stack_trajectories(trajs, out=view).param_version
                 )
             self._count_stack_bytes(view)
+        self._next_batch_lineage(lids, unroll_versions)
         return sb._replace(param_version=min(versions))
 
     def _validate_tasks(self, task: np.ndarray) -> None:
@@ -1154,13 +1221,23 @@ class Learner:
         # local slice becomes its shards of the global batch array.
         return multihost.place_batch(self._batch_shardings, arrays)
 
-    def _push_device_batch(self, on_device, param_version: int) -> bool:
-        """Bounded put into the device queue; False when stopping."""
+    def _push_device_batch(
+        self,
+        on_device,
+        param_version: int,
+        meta: Optional[BatchLineage] = None,
+    ) -> bool:
+        """Bounded put into the device queue; False when stopping. Queue
+        items are `(arrays, param_version, BatchLineage)` — the lineage
+        rides next to the batch so the train-step trace span can name
+        the exact unrolls (and staleness) it consumed."""
         while True:
             if self._stop.is_set():
                 return False
             try:
-                self._batch_q.put((on_device, param_version), timeout=0.5)
+                self._batch_q.put(
+                    (on_device, param_version, meta), timeout=0.5
+                )
                 return True
             except queue.Full:
                 continue
@@ -1193,12 +1270,22 @@ class Learner:
             # (jax's copy itself may complete asynchronously — the
             # double-buffering design point); a growing value here still
             # flags the feed path, which is what the breakdown is for.
+            meta = self._last_lineage
+            put_t0 = time.monotonic_ns()
             put_span = self._m_device_put.time()
             put_span.__enter__()
             on_device = self._put_batch(arrays)
             put_span.__exit__()
+            self._tracer.complete(
+                "learner/device_put",
+                put_t0,
+                time.monotonic_ns() - put_t0,
+                {"batch": meta.batch},
+            )
             self._record_pending_transfer(on_device)
-            if not self._push_device_batch(on_device, batch.param_version):
+            if not self._push_device_batch(
+                on_device, batch.param_version, meta
+            ):
                 return
 
     def _ring_batcher_loop(self) -> None:
@@ -1223,19 +1310,38 @@ class Learner:
             view = ring.pop_ready(timeout=0.5)
             if view is None:
                 continue
+            meta = self._next_batch_lineage(view.lineage, view.versions)
+            stack_t0 = time.monotonic_ns()
             with self._m_host_stack.time():
                 arrays = view.arrays
                 if copy_before_put:
                     arrays = jax.tree.map(
                         lambda x: np.array(x, copy=True), arrays
                     )
+            self._tracer.complete(
+                "learner/host_stack",
+                stack_t0,
+                time.monotonic_ns() - stack_t0,
+                {
+                    "batch": meta.batch,
+                    "lineage": list(meta.lineage),
+                    "slot": view.slot,
+                },
+            )
             if copy_before_put:
                 self._m_ring_stage_bytes.inc(tree_nbytes(arrays))
             self._validate_tasks(arrays[6])
+            put_t0 = time.monotonic_ns()
             put_span = self._m_device_put.time()
             put_span.__enter__()
             on_device = self._put_batch(arrays)
             put_span.__exit__()
+            self._tracer.complete(
+                "learner/device_put",
+                put_t0,
+                time.monotonic_ns() - put_t0,
+                {"batch": meta.batch},
+            )
             if copy_before_put:
                 # The staged copy owns its memory; the slot is free now.
                 ring.release(view.slot)
@@ -1273,7 +1379,9 @@ class Learner:
                     while len(inflight) > keep:
                         s, pending = inflight.popleft()
                         ring.release_after_transfer(s, pending)
-            if not self._push_device_batch(on_device, view.param_version):
+            if not self._push_device_batch(
+                on_device, view.param_version, meta
+            ):
                 return
 
     def start(self) -> None:
@@ -1294,6 +1402,7 @@ class Learner:
     # ---- stepping ------------------------------------------------------
 
     def _publish(self) -> None:
+        pub_t0 = time.monotonic_ns()
         with self._m_publish.time():
             # Kick off all leaf D2H copies before materializing any:
             # np.asarray alone would serialize one synchronous transfer
@@ -1309,6 +1418,14 @@ class Learner:
             self.param_store.publish(
                 self.num_frames, host_snapshot(self._params)
             )
+        # Publish closes the lineage loop: the version stamped here is
+        # what the next unrolls' lineage records carry as param_version.
+        self._tracer.complete(
+            "learner/publish",
+            pub_t0,
+            time.monotonic_ns() - pub_t0,
+            {"version": self.num_frames},
+        )
 
     def step_once(self, timeout: Optional[float] = None) -> Mapping[str, Any]:
         """Block for one device batch, take one SGD step, publish params.
@@ -1321,7 +1438,9 @@ class Learner:
             raise RuntimeError("learner batcher thread died") from self.error
         t0 = time.monotonic()
         try:
-            arrays, batch_version = self._batch_q.get(timeout=timeout)
+            arrays, batch_version, meta = self._batch_q.get(
+                timeout=timeout
+            )
         finally:
             # Count timed-out waits too (queue.Empty propagates to the run
             # loop): starvation time must not vanish from the diagnostic
@@ -1330,6 +1449,7 @@ class Learner:
             self._wait_accum += wait
             self._m_batch_wait.observe(wait)
         step_t0 = time.monotonic()
+        step_t0_ns = time.monotonic_ns()
         step = (
             self._auto_compiled
             if self._auto_compiled is not None
@@ -1402,12 +1522,40 @@ class Learner:
         # async-dispatch backend the tail of the compute may overlap the
         # next host iteration; the steady-state EWMA still tracks the
         # device step (the pipeline re-synchronizes on the batch queue).
+        step_dur_ns = time.monotonic_ns() - step_t0_ns
         self._m_train_step.observe(time.monotonic() - step_t0)
         T = self._config.unroll_length
         K = self._config.steps_per_dispatch
         self.num_frames += T * self._config.batch_size * K
         self.num_steps += K
         self._m_param_lag.set(self.num_frames - batch_version)
+        # The trace side of the staleness story: EXACT per-unroll lags
+        # for THIS batch (frame counter after the update minus each
+        # consumed unroll's acting param version — the same convention
+        # the param_lag_frames gauge summarizes by its min-version).
+        if meta is None:
+            meta = BatchLineage(batch=-1)
+        lags = [self.num_frames - v for v in meta.versions]
+        self._tracer.complete(
+            "learner/train_step",
+            step_t0_ns,
+            step_dur_ns,
+            {
+                "batch": meta.batch,
+                "step": self.num_steps,
+                "lineage": list(meta.lineage),
+                "param_versions": list(meta.versions),
+                "param_lag_frames": lags,
+                "param_lag_min": (
+                    min(lags) if lags
+                    else self.num_frames - batch_version
+                ),
+                "param_lag_max": (
+                    max(lags) if lags
+                    else self.num_frames - batch_version
+                ),
+            },
+        )
         self._telemetry.heartbeat("learner")
         logs = dict(logs)
         logs["num_frames"] = self.num_frames

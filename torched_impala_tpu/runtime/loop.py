@@ -23,7 +23,11 @@ from torched_impala_tpu.runtime.actor import Actor
 from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
 from torched_impala_tpu.runtime.supervisor import ActorSupervisor
 from torched_impala_tpu.runtime.vector_actor import VectorActor
-from torched_impala_tpu.telemetry import StallWatchdog, get_registry
+from torched_impala_tpu.telemetry import (
+    StallWatchdog,
+    get_recorder,
+    get_registry,
+)
 
 
 @dataclasses.dataclass
@@ -60,6 +64,7 @@ def train(
     telemetry_interval: int = 1,
     stall_timeout: float = 0.0,
     on_learner_step: Optional[Callable[[int], None]] = None,
+    trace_path: Optional[str] = None,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -117,6 +122,12 @@ def train(
     - `on_learner_step(num_steps)` is called after every learner step
       (and once at startup with the restored step count) — run.py's
       `--profile-steps` window hooks in here.
+    - `trace_path="out.json"` exports the flight recorder's retained
+      events (telemetry/tracing.py: per-unroll lineage IDs threaded
+      env→pool→queue/ring→learner, exact per-batch param lag) as
+      Chrome-trace JSON when the run ends — crash- and stop-safe via
+      the same finally that tears the pipeline down. Load it in
+      Perfetto (docs/OBSERVABILITY.md).
     """
     if actor_mode not in ("thread", "process"):
         raise ValueError(f"unknown actor_mode {actor_mode!r}")
@@ -404,6 +415,20 @@ def train(
             stall_watchdog.stop()
         stop_event.set()
         learner.stop()
+        if trace_path:
+            try:
+                n = get_recorder().export(trace_path)
+                print(
+                    f"[flight-recorder] {n} events -> {trace_path}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                print(
+                    f"[flight-recorder] export failed: {e!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
         # Drain the trajectory queue so actor threads blocked on a full
         # queue can observe the stop event and exit.
         try:

@@ -47,6 +47,10 @@ import numpy as np
 
 from torched_impala_tpu.runtime.types import QueueClosed, Trajectory
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+)
 
 
 class RingBlock(NamedTuple):
@@ -75,16 +79,21 @@ class RingBlock(NamedTuple):
 class ReadySlot(NamedTuple):
     """A completed slot handed to the batcher: `arrays` is the exact
     8-tuple the train step consumes (no restacking), views into the slot
-    buffers — valid until `release(slot)`."""
+    buffers — valid until `release(slot)`. `lineage` is the committed
+    blocks' lineage IDs in column order and `versions` their param
+    versions (one entry per block) — the per-batch provenance the
+    flight recorder threads to the learner's train-step span."""
 
     slot: int
     arrays: tuple
     param_version: int
+    lineage: tuple = ()
+    versions: tuple = ()
 
 
 class _Slot:
     __slots__ = ("buffers", "versions", "gen", "next_col", "committed",
-                 "aborted")
+                 "aborted", "lineage")
 
     def __init__(self, buffers: Trajectory, batch_size: int):
         self.buffers = buffers
@@ -93,6 +102,9 @@ class _Slot:
         self.next_col = 0  # columns handed out to writers
         self.committed = 0  # columns committed or aborted
         self.aborted = False
+        # col_start -> (lineage_id, param_version) per committed block;
+        # pop_ready flattens it in column order.
+        self.lineage: dict = {}
 
 
 class TrajectoryRing:
@@ -109,6 +121,7 @@ class TrajectoryRing:
         num_actions: int,
         agent_state_example: Any = (),
         telemetry: Optional[Registry] = None,
+        tracer: Optional[FlightRecorder] = None,
     ) -> None:
         if num_slots < 2:
             # One slot can never overlap filling with an in-flight H2D
@@ -160,6 +173,7 @@ class TrajectoryRing:
         self._cond = threading.Condition()
 
         reg = telemetry if telemetry is not None else get_registry()
+        self._tracer = tracer if tracer is not None else get_recorder()
         self._m_acquire_ms = reg.histogram("ring/acquire_block_ms")
         self._m_recycle_ms = reg.histogram("ring/recycle_wait_ms")
         self._m_batches = reg.counter("ring/batches")
@@ -179,13 +193,17 @@ class TrajectoryRing:
 
     # -- writer (actor) side ----------------------------------------------
 
-    def acquire(self, num_cols: int) -> RingBlock:
+    def acquire(
+        self, num_cols: int, lineage_id: str = ""
+    ) -> RingBlock:
         """Reserve `num_cols` columns of the filling slot; blocks while
         every slot is busy (the ring's backpressure edge — the analog of
         a full trajectory queue). Raises QueueClosed after `close()`.
 
         `num_cols` must divide `batch_size` so blocks never straddle a
-        slot boundary (every writer's columns land in ONE batch)."""
+        slot boundary (every writer's columns land in ONE batch).
+        `lineage_id` tags the flight-recorder acquire span (the span's
+        duration IS the ring backpressure the writer just paid)."""
         if num_cols < 1 or self.batch_size % num_cols:
             raise ValueError(
                 f"block of {num_cols} columns must divide batch_size "
@@ -205,8 +223,13 @@ class TrajectoryRing:
                     slot.next_col += num_cols
                     if slot.next_col >= self.batch_size:
                         self._filling = None  # fully handed out
-                    self._m_acquire_ms.observe(
-                        (time.monotonic() - t0) * 1e3
+                    now = time.monotonic()
+                    self._m_acquire_ms.observe((now - t0) * 1e3)
+                    self._tracer.complete(
+                        "ring/acquire",
+                        int(t0 * 1e9),
+                        int((now - t0) * 1e9),
+                        {"lid": lineage_id, "slot": s, "cols": c0},
                     )
                     return self._block(s, slice(c0, c0 + num_cols))
                 self._cond.wait(timeout=0.5)
@@ -228,10 +251,17 @@ class TrajectoryRing:
             agent_state=jax.tree.map(lambda x: x[cols], buf.agent_state),
         )
 
-    def commit(self, block: RingBlock, param_version: int) -> None:
+    def commit(
+        self,
+        block: RingBlock,
+        param_version: int,
+        lineage_id: str = "",
+    ) -> None:
         """Publish a fully-written block. When the slot's last block
         commits, the slot becomes a ready batch. Committing against a
-        recycled slot (generation mismatch — a stale writer) raises."""
+        recycled slot (generation mismatch — a stale writer) raises.
+        `lineage_id` records which unroll filled these columns; the
+        completed slot hands the whole list to the batcher."""
         with self._cond:
             slot = self._slots[block.slot]
             if slot.gen != block.gen:
@@ -241,7 +271,16 @@ class TrajectoryRing:
                     "writer held its block across a slot recycle"
                 )
             slot.versions[block.cols] = param_version
+            slot.lineage[block.cols.start] = (lineage_id, param_version)
             slot.committed += block.cols.stop - block.cols.start
+            self._tracer.instant(
+                "ring/commit",
+                {
+                    "lid": lineage_id,
+                    "slot": block.slot,
+                    "param_version": param_version,
+                },
+            )
             self._maybe_complete_locked(block.slot)
 
     def abort(self, block: RingBlock) -> None:
@@ -292,6 +331,7 @@ class TrajectoryRing:
             slot = self._slots[s]
             self._m_batches.inc()
             buf = slot.buffers
+            blocks = [slot.lineage[c] for c in sorted(slot.lineage)]
             return ReadySlot(
                 slot=s,
                 arrays=(
@@ -305,6 +345,8 @@ class TrajectoryRing:
                     buf.agent_state,
                 ),
                 param_version=int(slot.versions.min()),
+                lineage=tuple(lid for lid, _ in blocks),
+                versions=tuple(v for _, v in blocks),
             )
 
     def release(self, s: int) -> None:
@@ -315,6 +357,7 @@ class TrajectoryRing:
         with self._cond:
             self._recycle_locked(s)
             self._cond.notify_all()
+        self._tracer.instant("ring/release", {"slot": s})
 
     def release_after_transfer(self, s: int, pending) -> None:
         """Block out slot `s`'s device transfer, then recycle it: until
@@ -334,6 +377,7 @@ class TrajectoryRing:
         slot.next_col = 0
         slot.committed = 0
         slot.aborted = False
+        slot.lineage = {}
         self._free.append(s)
 
     def close(self) -> None:

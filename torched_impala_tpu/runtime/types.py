@@ -39,6 +39,10 @@ class Trajectory(NamedTuple):
       task: int task id of the env that produced the unroll (selects the
         PopArt value column for multi-task configs; 0 for single-task).
         Batched trajectories carry an int32 `[B]` array here.
+      lineage_id: flight-recorder lineage ID of the unroll cycle that
+        produced this trajectory (`a<actor>u<seq>`, telemetry/tracing.py);
+        "" from writers that don't trace. Batched trajectories carry a
+        tuple of the consumed unrolls' IDs.
     """
 
     obs: np.ndarray
@@ -51,6 +55,7 @@ class Trajectory(NamedTuple):
     actor_id: int = 0
     param_version: int = 0
     task: int = 0
+    lineage_id: Any = ""
 
 
 def host_snapshot(tree: Any) -> Any:

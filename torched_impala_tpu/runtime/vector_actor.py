@@ -49,6 +49,11 @@ from torched_impala_tpu.runtime.types import (
     host_snapshot,
 )
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+    mint_lineage_id,
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -87,6 +92,7 @@ class VectorActor:
         tasks: Optional[Sequence[int]] = None,
         telemetry: Optional[Registry] = None,
         traj_ring: Optional[TrajectoryRing] = None,
+        tracer: Optional[FlightRecorder] = None,
     ) -> None:
         """`tasks` overrides the per-env task ids (default: each env's
         `task_id` attribute, else 0). `device` pins policy inference — see
@@ -131,6 +137,14 @@ class VectorActor:
         self._m_ready_frac = reg.gauge("actor/ready_fraction_achieved")
         self._m_grace_ms = reg.gauge("actor/grace_window_ms")
         self._m_unroll = reg.timer("actor/unroll")
+        # Flight recorder + lineage (telemetry/tracing.py): one lineage
+        # ID per unroll cycle, stamped with the acting param version and
+        # threaded through the pool waves, the queue/ring, and the
+        # learner — so a trace names exactly which unrolls each learner
+        # batch consumed.
+        self._tracer = tracer if tracer is not None else get_recorder()
+        self._unroll_seq = 0
+        self._lid = ""
 
         if hasattr(envs, "step_all"):  # batched env (ProcessEnvPool)
             self._pool = envs
@@ -211,11 +225,19 @@ class VectorActor:
         self, t0: float, rows: int, ready_frac: float
     ) -> None:
         """One inference wave completed: latency histogram, wave-shape
-        gauges, and the liveness heartbeat the stall watchdog reads."""
-        self._m_wave_ms.observe((time.monotonic() - t0) * 1e3)
+        gauges, a flight-recorder span carrying the unroll's lineage ID,
+        and the liveness heartbeat the stall watchdog reads."""
+        now = time.monotonic()
+        self._m_wave_ms.observe((now - t0) * 1e3)
         self._m_waves.inc()
         self._m_wave_size.set(rows)
         self._m_ready_frac.set(ready_frac)
+        self._tracer.complete(
+            "actor/wave",
+            int(t0 * 1e9),
+            int((now - t0) * 1e9),
+            {"lid": self._lid, "rows": rows},
+        )
         self._telemetry.heartbeat("actor")
 
     def _unroll_buffers(self, T: int, E: int):
@@ -229,7 +251,7 @@ class VectorActor:
         `Trajectory`s; logits allocate lazily (the width is only known
         after the first inference)."""
         if self._ring is not None:
-            block = self._ring.acquire(E)
+            block = self._ring.acquire(E, lineage_id=self._lid)
             return (
                 block,
                 block.obs,
@@ -268,7 +290,9 @@ class VectorActor:
                     block.agent_state,
                     start_state,
                 )
-            self._ring.commit(block, param_version)
+            self._ring.commit(
+                block, param_version, lineage_id=self._lid
+            )
             return []
         return [
             Trajectory(
@@ -284,6 +308,7 @@ class VectorActor:
                 actor_id=self._id,
                 param_version=param_version,
                 task=self._tasks[i],
+                lineage_id=self._lid,
             )
             for i in range(self.num_envs)
         ]
@@ -291,7 +316,34 @@ class VectorActor:
     def unroll(self, params, param_version: int = 0) -> List[Trajectory]:
         """Step all E envs for T steps; return E single-env trajectories
         (an empty list in trajectory-ring mode — the unroll was committed
-        straight into a shared learner batch slot)."""
+        straight into a shared learner batch slot).
+
+        Mints this cycle's lineage ID (`a<actor>u<seq>`) and records the
+        whole cycle as an `actor/unroll` flight-recorder span stamped
+        with the acting param version; every downstream stage that
+        touches the unroll's bytes reuses the ID."""
+        self._lid = lid = mint_lineage_id(self._id, self._unroll_seq)
+        self._unroll_seq += 1
+        if self._pool is not None:
+            # The pool's parent-side trace events (submit->ack worker
+            # steps) tag themselves with the driving unroll's lineage.
+            self._pool.trace_lineage = lid
+        t0_ns = time.monotonic_ns()
+        try:
+            return self._unroll_cycle(params, param_version)
+        finally:
+            self._tracer.complete(
+                "actor/unroll",
+                t0_ns,
+                time.monotonic_ns() - t0_ns,
+                {
+                    "lid": lid,
+                    "param_version": param_version,
+                    "envs": self.num_envs,
+                },
+            )
+
+    def _unroll_cycle(self, params, param_version: int) -> List[Trajectory]:
         if self._pool_async:
             return self._unroll_async(params, param_version)
         T, E = self._unroll_length, self.num_envs

@@ -1,7 +1,9 @@
-"""Telemetry subsystem: metrics registry, stall watchdog, profiler capture.
+"""Telemetry subsystem: metrics registry, stall watchdog, profiler
+capture, flight recorder.
 
 See `registry.py` for the metric model, `watchdog.py` for stall
-detection, `profiling.py` for on-demand `jax.profiler` windows, and
+detection, `profiling.py` for on-demand `jax.profiler` windows,
+`tracing.py` for the flight recorder + per-batch lineage tracing, and
 docs/OBSERVABILITY.md for the gauge -> pipeline-stage map.
 """
 
@@ -26,6 +28,14 @@ from torched_impala_tpu.telemetry.profiling import (
     StepWindowProfiler,
     parse_profile_steps,
 )
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+    install_sigusr2,
+    mint_lineage_id,
+    set_trace_enabled,
+    validate_chrome_trace,
+)
 
 __all__ = [
     "DEFAULT_MS_BUCKETS",
@@ -43,4 +53,10 @@ __all__ = [
     "ProfilerCapture",
     "StepWindowProfiler",
     "parse_profile_steps",
+    "FlightRecorder",
+    "get_recorder",
+    "install_sigusr2",
+    "mint_lineage_id",
+    "set_trace_enabled",
+    "validate_chrome_trace",
 ]

@@ -214,8 +214,9 @@ class _SpanContext:
 class Histogram(_Metric):
     """Fixed-bucket latency histogram. Bucket edges are UPPER bounds
     (inclusive); one implicit +inf bucket catches the tail. Snapshot emits
-    `<name>_p50` / `<name>_p95` (linear interpolation inside the winning
-    bucket), `<name>_mean`, `<name>_max`, and `<name>_count`."""
+    `<name>_p50` / `<name>_p95` / `<name>_p99` (linear interpolation
+    inside the winning bucket; the +inf bucket reports the observed max),
+    `<name>_mean`, `<name>_max`, and `<name>_count`."""
 
     kind = "histogram"
 
@@ -263,7 +264,10 @@ class Histogram(_Metric):
     def percentile(self, q: float) -> float:
         """Estimate the q-quantile (0 < q <= 1) from bucket counts: find
         the bucket holding the q*count-th observation and interpolate
-        linearly inside it. The +inf bucket reports the max observed."""
+        linearly inside it, clamped to the observed max (interpolation
+        toward a bucket's upper edge can otherwise exceed every actual
+        observation — no real quantile can). The +inf bucket reports the
+        max observed."""
         counts, total, _, mx = self._state()
         if total == 0:
             return float("nan")
@@ -278,7 +282,7 @@ class Histogram(_Metric):
                 lo = 0.0 if i == 0 else self.edges[i - 1]
                 hi = self.edges[i]
                 frac = (rank - prev_cum) / c if c else 1.0
-                return lo + frac * (hi - lo)
+                return min(lo + frac * (hi - lo), mx)
         return mx
 
     def snapshot_into(self, out: Dict[str, float]) -> None:
@@ -290,11 +294,13 @@ class Histogram(_Metric):
             out[f"{base}_max"] = float("nan")
             out[f"{base}_p50"] = float("nan")
             out[f"{base}_p95"] = float("nan")
+            out[f"{base}_p99"] = float("nan")
             return
         out[f"{base}_mean"] = sm / total
         out[f"{base}_max"] = mx
         out[f"{base}_p50"] = self.percentile(0.50)
         out[f"{base}_p95"] = self.percentile(0.95)
+        out[f"{base}_p99"] = self.percentile(0.99)
 
 
 class Registry:

@@ -11,9 +11,12 @@ within `deadline_s`, the watchdog
 
 1. dumps every Python thread's stack to stderr (the wedged frame is
    almost always visible there),
-2. dumps the latest registry snapshot (which stage's counters froze tells
+2. dumps the flight recorder's tail (telemetry/tracing.py) — the last
+   few dozen trace events, lineage IDs included, so the dump names
+   WHICH unroll/batch the pipeline wedged on, not just where,
+3. dumps the latest registry snapshot (which stage's counters froze tells
    you WHERE the pipeline wedged),
-3. increments `telemetry/watchdog/stall` and calls `on_stall(event)` so
+4. increments `telemetry/watchdog/stall` and calls `on_stall(event)` so
    the stall reaches the metrics log as an event, not just stderr.
 
 It fires ONCE per stall and re-arms when progress resumes, so a long
@@ -29,6 +32,10 @@ import traceback
 from typing import Callable, Dict, Optional
 
 from torched_impala_tpu.telemetry.registry import PREFIX, Registry
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+)
 
 
 def dump_thread_stacks(file=None) -> None:
@@ -68,10 +75,16 @@ class StallWatchdog:
         on_stall: Optional[Callable[[Dict[str, float]], None]] = None,
         poll_s: Optional[float] = None,
         stream=None,
+        recorder: Optional[FlightRecorder] = None,
+        tail_records: int = 48,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self._registry = registry
+        # The flight recorder whose tail rides the stall dump (None =
+        # the process-global one every pipeline stage records into).
+        self._recorder = recorder if recorder is not None else get_recorder()
+        self._tail_records = tail_records
         self._deadline_s = deadline_s
         self._on_stall = on_stall
         self._poll_s = (
@@ -147,6 +160,16 @@ class StallWatchdog:
             flush=True,
         )
         dump_thread_stacks(stream)
+        # The forensic timeline: which unrolls/batches (lineage IDs) were
+        # in flight when the pipeline went quiet.
+        print(
+            f"[stall-watchdog] flight recorder tail "
+            f"(last {self._tail_records} of "
+            f"{self._recorder.total_recorded} events):",
+            file=stream,
+        )
+        stream.write(self._recorder.format_tail(self._tail_records))
+        stream.flush()
         snap = self._registry.snapshot()
         print(
             "[stall-watchdog] registry snapshot: "
