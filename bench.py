@@ -287,6 +287,10 @@ def main(args) -> None:
     # acceptance: host_stack span + per-unroll enqueue copy bytes drop,
     # batches bit-identical on fixed seeds).
     section("traj_ring", lambda: run_bench_traj_ring(jax))
+    # Host-side: resilience chaos harness (ISSUE 5 acceptance: SIGKILL'd
+    # env worker + crashed actor + crashed learner -> resume reaches the
+    # target step count; async checkpoint overhead < 1%).
+    section("chaos", lambda: run_bench_chaos(jax))
     section("e2e_components", lambda: run_e2e_components(jax))
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
@@ -1912,6 +1916,251 @@ def run_bench_traj_ring(jax, tiny: bool = False) -> dict:
         ),
     }
     log(f"bench: traj_ring: {out}")
+    return out
+
+
+def run_bench_chaos(jax, tiny: bool = False) -> dict:
+    """Resilience chaos bench (ISSUE 5 tentpole acceptance): inject the
+    fault plan {SIGKILL one env worker, crash one actor thread, crash the
+    learner mid-run} into a checkpointed training run, then prove the
+    system's recovery claims with numbers:
+
+    - the run dies at the injected learner crash WITHOUT a final save;
+      `--resume auto` restores the newest manifest and training reaches
+      the original target step count (`recovered`);
+    - lost progress is bounded by the checkpoint interval
+      (`lost_steps <= interval`);
+    - two resumes of the same manifest produce BIT-IDENTICAL first
+      post-recovery batches on fixed seeds (the determinism story of
+      utils/checkpoint.py extended through crash recovery);
+    - async checkpointing at a production cadence adds <1% to learner
+      steps/sec (`checkpoint_overhead_pct`: the per-save wall cost from
+      an every-step STRESS arm, amortized over a 100-step interval —
+      10x denser than the presets' default 1000; the train loop hands
+      the writer an on-device clone and never blocks on disk).
+
+    tests/test_bench_units.py asserts the tiny variant with CI slack."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import optax
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.resilience import (
+        AsyncCheckpointer,
+        ChaosError,
+        ChaosPlan,
+        config_fingerprint,
+        restore_latest,
+    )
+    from torched_impala_tpu.runtime import Learner, LearnerConfig, VectorActor
+    from torched_impala_tpu.runtime.loop import train
+    from torched_impala_tpu.telemetry import Registry
+
+    cfg = configs.CARTPOLE
+    agent = configs.make_agent(cfg)
+    factory = configs.make_env_factory(cfg, fake=True)
+    lcfg = dataclasses.replace(configs.make_learner_config(cfg), batch_size=2)
+    fp = config_fingerprint(cfg)
+    if tiny:
+        target, crash_at, interval, overhead_steps = 8, 4, 2, 30
+    else:
+        target, crash_at, interval, overhead_steps = 30, 12, 3, 200
+    ckdir = tempfile.mkdtemp(prefix="bench_chaos_")
+    out: dict = {
+        "fault_plan": [
+            {"kind": "kill_env_worker", "at": 4, "target": 0},
+            {"kind": "raise_in_actor", "at": 3},
+            {"kind": "crash_learner", "at": crash_at},
+        ],
+        "target_steps": target,
+        "checkpoint_interval": interval,
+    }
+    common = dict(
+        agent=agent,
+        env_factory=factory,
+        example_obs=configs.example_obs(cfg),
+        num_actors=2,
+        learner_config=lcfg,
+        optimizer=configs.make_optimizer(cfg),
+        seed=0,
+        log_every=1,
+        config_hash=fp,
+    )
+    try:
+        # -- run 1: faults armed, dies at the injected learner crash ----
+        ck = AsyncCheckpointer(
+            ckdir, keep=3, interval_steps=interval, config_hash=fp
+        )
+        from torched_impala_tpu.resilience import ChaosInjector
+
+        injector = ChaosInjector(ChaosPlan.from_dicts(out["fault_plan"]))
+        crashed = False
+        try:
+            train(
+                total_steps=target,
+                async_checkpointer=ck,
+                chaos=injector,
+                actor_mode="process",
+                envs_per_actor=2,
+                **common,
+            )
+        except ChaosError:
+            crashed = True
+        ck.wait()
+        saved = ck.all_steps()
+        ck.close()
+        out["crashed_as_injected"] = crashed
+        out["crash_step"] = crash_at
+        out["saved_steps"] = saved
+        # Every armed fault fired, and the learner still reached the
+        # crash step — i.e. the worker SIGKILL and the actor crash were
+        # absorbed by the pool repair / supervisor BEFORE the injected
+        # learner death ended the run.
+        out["faults_fired"] = sorted(f.kind for f in injector.fired)
+
+        # -- post-recovery determinism: resume the SAME manifest twice,
+        # the first assembled batch must be bit-identical -------------
+        def first_batch_after_resume():
+            reg = Registry()
+            learner = Learner(
+                agent=agent,
+                optimizer=configs.make_optimizer(cfg),
+                config=lcfg,
+                example_obs=configs.example_obs(cfg),
+                rng=jax.random.key(0),
+                telemetry=reg,
+            )
+            manifest, state = restore_latest(
+                ckdir, learner.get_state(), config_hash=fp
+            )
+            learner.set_state(state)
+            actor = VectorActor(
+                actor_id=0,
+                envs=[factory(1000 + j, j) for j in range(2)],
+                agent=agent,
+                param_store=learner.param_store,
+                enqueue=learner.enqueue,
+                unroll_length=lcfg.unroll_length,
+                seed=7,
+                telemetry=reg,
+            )
+            learner.start()
+            try:
+                actor.unroll_and_push()
+                arrays, version, _ = learner._batch_q.get(timeout=300)
+                return (
+                    manifest.step,
+                    jax.tree.map(
+                        lambda x: np.array(x, copy=True), arrays
+                    ),
+                )
+            finally:
+                learner.stop()
+
+        step_a, batch_a = first_batch_after_resume()
+        step_b, batch_b = first_batch_after_resume()
+        identical = step_a == step_b and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(batch_a), jax.tree.leaves(batch_b))
+        )
+        out["resumed_from_step"] = step_a
+        out["lost_steps"] = crash_at - step_a
+        out["post_recovery_batches_bit_identical"] = identical
+
+        # -- run 2: --resume auto back to the full target --------------
+        ck2 = AsyncCheckpointer(
+            ckdir, keep=3, interval_steps=interval, config_hash=fp
+        )
+        result = train(
+            total_steps=target,
+            async_checkpointer=ck2,
+            resume="auto",
+            **common,
+        )
+        ck2.close()
+        out["final_steps"] = result.learner.num_steps
+        out["actor_restarts"] = result.actor_restarts
+        out["recovered"] = result.learner.num_steps == target
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+    # -- checkpoint overhead on the learner step loop -------------------
+    def steps_per_sec(ck: "AsyncCheckpointer | None") -> float:
+        learner = Learner(
+            agent=Agent(ImpalaNet(num_actions=2, torso=MLPTorso())),
+            optimizer=optax.rmsprop(6e-4, decay=0.99, eps=1e-7),
+            config=LearnerConfig(batch_size=2, unroll_length=5),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+            telemetry=Registry(),
+        )
+        envs = [factory(2000 + j, j) for j in range(2)]
+        actor = VectorActor(
+            actor_id=0,
+            envs=envs,
+            agent=learner._agent,
+            param_store=learner.param_store,
+            enqueue=learner.enqueue,
+            unroll_length=5,
+            seed=11,
+            telemetry=Registry(),
+        )
+        if ck is not None:
+            learner.post_step = lambda n: ck.maybe_save(
+                n, learner.get_state_device, param_version=learner.num_frames
+            )
+        learner.start()
+        try:
+            for _ in range(3):  # warm the jits out of the timed window
+                actor.unroll_and_push()
+                learner.step_once(timeout=300)
+            t0 = time.perf_counter()
+            for _ in range(overhead_steps):
+                actor.unroll_and_push()
+                learner.step_once(timeout=300)
+            dt = time.perf_counter() - t0
+        finally:
+            learner.stop()
+        return overhead_steps / dt
+
+    sps_off = steps_per_sec(None)
+    ovdir = tempfile.mkdtemp(prefix="bench_chaos_ov_")
+    try:
+        # interval_steps=1 = a save attempt after EVERY learner step — a
+        # deliberate STRESS arm, ~100-1000x the production cadence
+        # (presets default checkpoint_interval=1000), so the per-save
+        # cost is measurable above timer noise. On this 1-core box the
+        # background writer contends with the learner for the only core
+        # (fsync x3 files + zip per save), so the stress number is an
+        # upper bound no multi-core host approaches.
+        ck = AsyncCheckpointer(ovdir, keep=2, interval_steps=1)
+        sps_on = steps_per_sec(ck)
+        ck.wait()
+        saves = ck.saves
+        ck.close()
+    finally:
+        shutil.rmtree(ovdir, ignore_errors=True)
+    out["steps_per_sec_off"] = round(sps_off, 2)
+    out["steps_per_sec_on_every_step"] = round(sps_on, 2)
+    out["overhead_saves"] = saves
+    out["overhead_pct_every_step"] = round(
+        (sps_off - sps_on) / sps_off * 100.0, 3
+    )
+    # The acceptance number: overhead at a production cadence. The
+    # every-step stress arm yields the full per-save wall cost (capture +
+    # write + fsync + contention); amortized over a 100-step interval —
+    # 10x DENSER than the presets' default of 1000 — it must sit below
+    # 1% of learner throughput.
+    per_save_s = max(0.0, 1.0 / sps_on - 1.0 / sps_off)
+    out["per_save_cost_ms"] = round(per_save_s * 1e3, 3)
+    out["checkpoint_overhead_pct"] = round(
+        per_save_s / (100.0 / sps_off) * 100.0, 4
+    )
+    log(f"bench: chaos: {out}")
     return out
 
 

@@ -99,6 +99,44 @@ def test_traj_ring_bench_overhead_bound(jax_cpu):
     assert r["host_stack_ms"] < q["host_stack_ms"], out
 
 
+def test_chaos_bench_recovers_with_bounded_overhead(jax_cpu):
+    """The ISSUE 5 acceptance bound, wired into CI via the bench chaos
+    section's tiny variant: with a fault plan that SIGKILLs one env
+    worker, crashes one actor thread, and crashes the learner mid-run,
+    training resumes from the latest manifest and reaches the target
+    step count; post-recovery batches are bit-identical across two
+    resumes of the same checkpoint; and async checkpointing's cost at a
+    production cadence (per-save wall cost amortized over a 100-step
+    interval, 10x denser than the presets' default 1000) stays under
+    1%. The CI assert keeps slack for scheduling noise on a loaded
+    runner (same convention as the tracing/telemetry bounds above).
+    Lost steps are bounded by TWO checkpoint intervals rather than one:
+    a save trigger that lands while the writer is mid-write is skipped
+    by design (the train loop never queues behind disk), which on a
+    slow runner can cost one extra interval."""
+    from bench import run_bench_chaos
+
+    out = run_bench_chaos(jax_cpu, tiny=True)
+    assert out["crashed_as_injected"]
+    assert out["recovered"], out
+    assert out["final_steps"] == out["target_steps"], out
+    assert (
+        out["lost_steps"] <= 2 * out["checkpoint_interval"]
+    ), out
+    assert out["post_recovery_batches_bit_identical"], out
+    # Every armed fault really fired — and since the learner still
+    # reached the injected crash step, the worker SIGKILL and the actor
+    # crash were absorbed by the pool repair / supervisor first.
+    assert out["faults_fired"] == [
+        "crash_learner", "kill_env_worker", "raise_in_actor",
+    ], out
+    assert out["overhead_saves"] > 0, out
+    # Measured ~0.3-0.7% at the 100-step amortization on this 1-core box
+    # (and far less on any multi-core host — the stress arm's background
+    # writer contends for the only core here); 5% = pure-noise ceiling.
+    assert out["checkpoint_overhead_pct"] < 5.0, out
+
+
 def test_tracing_bench_overhead_bound(jax_cpu):
     """The ISSUE 4 acceptance bound, wired into CI via the bench
     section's tiny variant: the flight recorder stays negligible with

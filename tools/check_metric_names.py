@@ -17,6 +17,11 @@ then asserts:
    split one series into two;
 3. every literal emitted key carries the `telemetry/` prefix and the same
    grammar;
+3b. `resilience/*` names (the resilience subsystem multiplexes several
+   sub-families into the two-segment grammar — the registry rejects
+   three-segment names) use a pinned sub-family prefix
+   (`checkpoint_`/`supervisor_`/`chaos_`/`recovery_`), so the family
+   stays greppable as `resilience/checkpoint_*` etc.;
 4. every trace event name follows the SAME `<component>/<name>` grammar
    (the recorder enforces it at runtime too; trace components map to
    Chrome-trace process rows, so a malformed name breaks the Perfetto
@@ -67,6 +72,11 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
 # span() is sugar over timer() — the two share a series by design.
 _CANONICAL = {"span": "timer"}
 
+# resilience/<name> must pick a sub-family (rule 3b above): the component
+# aggregates checkpointing, supervision, chaos, and recovery series, and
+# an unprefixed name would orphan itself from every dashboard glob.
+RESILIENCE_PREFIXES = ("checkpoint_", "supervisor_", "chaos_", "recovery_")
+
 
 def _py_files(root: str) -> List[str]:
     files = [os.path.join(root, "bench.py")]
@@ -105,6 +115,15 @@ def check(root: str = REPO) -> List[str]:
                             f"{site}: {kind} name {name!r} does not "
                             f"match <component>/<name> "
                             f"({NAME_RE.pattern})"
+                        )
+                        continue
+                    if name.startswith("resilience/") and not name.split(
+                        "/", 1
+                    )[1].startswith(RESILIENCE_PREFIXES):
+                        errors.append(
+                            f"{site}: resilience metric {name!r} must "
+                            f"use a sub-family prefix "
+                            f"{RESILIENCE_PREFIXES}"
                         )
                         continue
                     prev = seen.get(name)

@@ -16,6 +16,8 @@ check each subpackage's __init__ for what is implemented):
   over ICI; mesh layout leaves room for a model axis (`parallel/`).
 - Checkpoint/resume (orbax), eval runner, metrics, typed configs (`utils/`,
   `configs.py`, `run.py`).
+- Resilience: async atomic checkpointing, manifest-based crash-consistent
+  resume, chaos fault injection (`resilience/`, docs/RESILIENCE.md).
 """
 
 __version__ = "0.1.0"

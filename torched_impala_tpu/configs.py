@@ -151,6 +151,17 @@ class ExperimentConfig:
     # into a same-minute stack dump.
     telemetry_interval: int = 1
     stall_timeout_s: float = 300.0
+    # Resilience (torched_impala_tpu/resilience/, docs/RESILIENCE.md):
+    # checkpoint cadence and retention, wired through `--checkpoint-
+    # interval` / `--checkpoint-keep` / `--checkpoint-seconds`.
+    # `checkpoint_interval` is learner steps between saves;
+    # `checkpoint_seconds` (async backend only, 0 = off) additionally
+    # triggers a save when that much wall time passed — whichever comes
+    # first. `checkpoint_keep` bounds retained checkpoints in BOTH
+    # backends (orbax max_to_keep / async retention prune).
+    checkpoint_interval: int = 1000
+    checkpoint_keep: int = 3
+    checkpoint_seconds: float = 0.0
     # Flight-recorder export (telemetry/tracing.py): write the retained
     # trace events — per-unroll lineage IDs threaded env→pool→queue/
     # ring→learner with exact per-batch param lag — as Chrome-trace

@@ -10,8 +10,10 @@ Checks, in order:
 2. accelerator: jax backend init + one tiny jit (bounded by the caller's
    --platform choice; a wedged TPU tunnel surfaces here, not mid-run);
    then telemetry registry, flight-recorder trace round-trip (a 2-event
-   Chrome-trace export under traces/ reloaded + schema-validated), and
-   trajectory-ring spec checks;
+   Chrome-trace export under traces/ reloaded + schema-validated),
+   trajectory-ring spec checks, and the resilience self-check (atomic
+   checkpoint + manifest round-trip, corrupted-copy rejection,
+   config-hash resume refusal);
 3. per-family env contract: construct the REAL factory, reset, step a
    random policy N steps, validate the (obs, reward, terminated,
    truncated, info) surface, dtypes and shapes against the factory's
@@ -263,6 +265,83 @@ def _check_traj_ring() -> tuple[str, str]:
         return "FAIL", f"traj ring broken:\n{traceback.format_exc()}"
 
 
+def _check_resilience() -> tuple[str, str]:
+    """Resilience self-check (docs/RESILIENCE.md): write a checkpoint
+    through the async writer, round-trip the run manifest, corrupt a COPY
+    of the state file and verify the loader REJECTS it (clear error, no
+    garbage params), and verify a config-hash mismatch refuses to resume.
+    Purely local — a temp dir, a tiny state tree, no devices beyond one
+    array; proves the crash-recovery path is load-bearing BEFORE a long
+    run depends on it."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from torched_impala_tpu.resilience import (
+        AsyncCheckpointer,
+        ResumeConfigMismatch,
+        config_fingerprint,
+        load_manifest,
+        restore_latest,
+    )
+    from torched_impala_tpu.resilience import chaos as chaos_mod
+    from torched_impala_tpu.resilience import recovery
+    from torched_impala_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        load_state_file,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="doctor_resilience_")
+    try:
+        state = {
+            "params": {"w": np.arange(64.0).reshape(8, 8)},
+            "num_frames": np.asarray(480, np.int64),
+            "num_steps": np.asarray(3, np.int64),
+            "rng": np.asarray([0, 7], np.uint32),
+        }
+        fp = config_fingerprint({"preset": "doctor", "batch_size": 2})
+        ck = AsyncCheckpointer(
+            tmp, keep=2, interval_steps=1, config_hash=fp
+        )
+        try:
+            ck.save_now(3, state, param_version=480)
+            ck.wait()
+        finally:
+            ck.close()
+        manifest = load_manifest(recovery.manifest_path(tmp, 3))
+        assert manifest.step == 3 and manifest.param_version == 480, manifest
+        assert manifest.config_hash == fp, manifest
+        found = restore_latest(tmp, state, config_hash=fp)
+        assert found is not None
+        np.testing.assert_array_equal(
+            found[1]["params"]["w"], state["params"]["w"]
+        )
+        # Corrupt a COPY; the loader must reject it with the clear error.
+        bad = recovery.checkpoint_path(tmp, 3) + ".copy"
+        shutil.copyfile(recovery.checkpoint_path(tmp, 3), bad)
+        chaos_mod.corrupt_file(bad)
+        try:
+            load_state_file(bad, state)
+            return "FAIL", "corrupted checkpoint loaded without error"
+        except CheckpointCorruptError:
+            pass
+        # A mismatched config hash must refuse, not restore.
+        try:
+            restore_latest(tmp, state, config_hash="deadbeef00000000")
+            return "FAIL", "config-hash mismatch did not refuse resume"
+        except ResumeConfigMismatch:
+            pass
+        return "ok", (
+            "atomic save + manifest round-trip; corrupted copy rejected "
+            "(CheckpointCorruptError); config-hash mismatch refused"
+        )
+    except Exception:
+        return "FAIL", f"resilience stack broken:\n{traceback.format_exc()}"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _train_probe(config_name: str) -> tuple[str, str]:
     """Two real learner steps through the full runtime on the preset's
     REAL envs (no fakes) — the end-to-end first-contact check."""
@@ -361,6 +440,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_traj_ring()
     print(f"  traj ring  [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_resilience()
+    print(f"  resilience [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

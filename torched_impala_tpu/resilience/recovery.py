@@ -1,0 +1,240 @@
+"""Crash-consistent resume: run manifests + the recovery scan.
+
+Every resilience checkpoint save writes a JSON *run manifest* next to the
+state file — `{step, param_version, rng, config_hash, checkpoint}` — via
+the same atomic tmp+fsync+rename protocol (utils/checkpoint.py), so after
+a crash the directory always holds a consistent (manifest, checkpoint)
+pair for every retained step:
+
+    ckpt-000000000020.npz        # save_state_file (atomic, CRC'd)
+    manifest-000000000020.json   # RunManifest     (atomic)
+    MANIFEST.json                # latest-pointer copy of the newest one
+
+`restore_latest` walks the manifests newest-first, refuses a mismatched
+`config_hash` with an actionable error (resuming a run under a different
+experiment config silently corrupts the optimizer/lr-schedule alignment),
+and falls back — loudly — to the previous retained checkpoint when the
+newest state file fails its CRCs (`CheckpointCorruptError`). The learner's
+`set_state` then republishes params at the restored version, so actors and
+the trajectory ring resynchronize on the restored policy immediately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from torched_impala_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    atomic_write_bytes,
+    load_state_file,
+)
+
+MANIFEST_RE = re.compile(r"^manifest-(\d{12})\.json$")
+CHECKPOINT_FMT = "ckpt-{step:012d}.npz"
+MANIFEST_FMT = "manifest-{step:012d}.json"
+LATEST_MANIFEST = "MANIFEST.json"
+
+_FORMAT_VERSION = 1
+
+
+class ResumeConfigMismatch(RuntimeError):
+    """--resume pointed at checkpoints written under a DIFFERENT config
+    (hash mismatch). Refusing is deliberate: restoring opt state and step
+    counters into a changed experiment silently desynchronizes the lr
+    schedule and frame budget — pick the matching config, or a fresh
+    checkpoint dir."""
+
+
+def config_fingerprint(config: Any) -> str:
+    """Stable hash of an experiment/learner config: dataclasses flatten to
+    sorted-key JSON (nested dataclasses included, non-JSON leaves via
+    repr), so equal configs hash equal across processes and sessions."""
+
+    def jsonable(x):
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            return {
+                f.name: jsonable(getattr(x, f.name))
+                for f in dataclasses.fields(x)
+            }
+        if isinstance(x, dict):
+            return {str(k): jsonable(v) for k, v in sorted(x.items())}
+        if isinstance(x, (list, tuple)):
+            return [jsonable(v) for v in x]
+        if isinstance(x, (str, int, float, bool)) or x is None:
+            return x
+        return repr(x)
+
+    blob = json.dumps(jsonable(config), sort_keys=True)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class RunManifest:
+    """One checkpoint's resume metadata (JSON round-trippable)."""
+
+    step: int
+    param_version: int
+    checkpoint: str  # state filename, relative to the manifest's dir
+    config_hash: Optional[str] = None
+    rng: Optional[List[int]] = None  # raw uint32 key data, resume audit
+    saved_at: float = 0.0  # unix seconds
+    format: int = _FORMAT_VERSION
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "RunManifest":
+        obj = json.loads(blob)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in obj.items() if k in known})
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, MANIFEST_FMT.format(step=step))
+
+
+def checkpoint_path(directory: str, step: int) -> str:
+    return os.path.join(directory, CHECKPOINT_FMT.format(step=step))
+
+
+def write_manifest(directory: str, manifest: RunManifest) -> str:
+    """Atomically write the per-step manifest AND refresh the
+    `MANIFEST.json` latest-pointer; returns the per-step path. The state
+    file must already be on disk — manifest-after-checkpoint ordering is
+    what makes a crash between the two writes recoverable (a manifest
+    never points at a checkpoint that does not exist)."""
+    blob = manifest.to_json().encode("utf-8")
+    path = manifest_path(directory, manifest.step)
+    atomic_write_bytes(path, blob)
+    atomic_write_bytes(os.path.join(directory, LATEST_MANIFEST), blob)
+    return path
+
+
+def load_manifest(path: str) -> RunManifest:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return RunManifest.from_json(f.read())
+    except (OSError, ValueError, TypeError) as e:
+        raise CheckpointCorruptError(
+            f"run manifest {path} is unreadable "
+            f"({type(e).__name__}: {e}); resume will fall back to an "
+            "earlier retained checkpoint"
+        ) from e
+
+
+def list_manifest_steps(directory: str) -> List[int]:
+    """Retained steps with a per-step manifest on disk, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = MANIFEST_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def restore_latest(
+    directory: str,
+    target: Any,
+    *,
+    config_hash: Optional[str] = None,
+) -> Optional[Tuple[RunManifest, Any]]:
+    """Load the newest loadable (manifest, state) pair from `directory`.
+
+    Returns None when no manifests exist (a fresh run). Raises
+    `ResumeConfigMismatch` when the newest manifest's config hash differs
+    from `config_hash` (when both are present) — a corrupt checkpoint is
+    recoverable, a wrong config is not. Checkpoints that fail their CRCs
+    are skipped with a stderr warning, falling back to the previous
+    retained step; raises `CheckpointCorruptError` when every retained
+    checkpoint is damaged."""
+    steps = list_manifest_steps(directory)
+    if not steps:
+        return None
+    last_error: Optional[BaseException] = None
+    hash_checked = False
+    for step in reversed(steps):
+        try:
+            manifest = load_manifest(manifest_path(directory, step))
+        except CheckpointCorruptError as e:
+            last_error = e
+            print(f"[resume] {e}", file=sys.stderr, flush=True)
+            continue
+        # Verify the config hash on the first LOADABLE manifest (not
+        # just the newest file — that one may itself be unreadable): a
+        # corrupt checkpoint is recoverable, a wrong config is not.
+        if (
+            not hash_checked
+            and config_hash is not None
+            and manifest.config_hash is not None
+            and manifest.config_hash != config_hash
+        ):
+            raise ResumeConfigMismatch(
+                f"checkpoints in {directory} were written under config "
+                f"hash {manifest.config_hash} but this run's config "
+                f"hashes to {config_hash}. Refusing to resume: restoring "
+                "opt state/step counters across configs desynchronizes "
+                "the lr schedule and frame budget. Use the original "
+                "config, or point --checkpoint-dir at a fresh directory."
+            )
+        hash_checked = True
+        ckpt = os.path.join(directory, manifest.checkpoint)
+        try:
+            state = load_state_file(ckpt, target)
+        except CheckpointCorruptError as e:
+            last_error = e
+            print(
+                f"[resume] step {step} checkpoint unusable, falling back "
+                f"to the previous retained step: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
+        return manifest, state
+    raise CheckpointCorruptError(
+        f"every retained checkpoint in {directory} is unreadable "
+        f"(steps {steps}); last error: {last_error}"
+    )
+
+
+def prune(directory: str, keep: int) -> List[int]:
+    """Delete (manifest, checkpoint) pairs beyond the newest `keep`;
+    returns the pruned steps. The latest-pointer MANIFEST.json is never
+    touched."""
+    steps = list_manifest_steps(directory)
+    doomed = steps[:-keep] if keep > 0 else []
+    for step in doomed:
+        for path in (
+            manifest_path(directory, step),
+            checkpoint_path(directory, step),
+        ):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return doomed
+
+
+def manifest_rng(rng: Any) -> Optional[List[int]]:
+    """Raw uint32 key data of a (possibly typed) PRNG key as a JSON list —
+    the manifest's resume-audit copy of the checkpointed rng stream."""
+    if rng is None:
+        return None
+    import jax
+
+    from torched_impala_tpu.utils.checkpoint import jnp_issubdtype_prng
+
+    if jnp_issubdtype_prng(rng):
+        rng = jax.random.key_data(rng)
+    return [int(x) for x in np.asarray(rng).ravel()]

@@ -135,9 +135,35 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--logdir", default="/tmp/torched_impala_tpu")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--checkpoint-dir", default=None)
-    p.add_argument("--checkpoint-interval", type=int, default=1000,
-                   help="learner steps between checkpoint saves")
-    p.add_argument("--resume", action="store_true")
+    p.add_argument("--checkpoint-interval", type=int, default=None,
+                   help="learner steps between checkpoint saves "
+                        "(default: preset's checkpoint_interval, 1000)")
+    p.add_argument("--checkpoint-keep", type=int, default=None,
+                   help="retained checkpoints, both backends (default: "
+                        "preset's checkpoint_keep, 3)")
+    p.add_argument("--checkpoint-seconds", type=float, default=None,
+                   help="async backend: also save when this much wall "
+                        "time passed since the last save (0 = step "
+                        "cadence only; default: preset)")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="resilience backend for interval saves: a "
+                        "background thread writes atomic checkpoints + "
+                        "JSON run manifests under --checkpoint-dir and "
+                        "the train loop never blocks on disk "
+                        "(resilience/checkpointer.py; the final save "
+                        "still lands in orbax so --mode eval works)")
+    p.add_argument("--resume", nargs="?", const="auto", default=None,
+                   choices=("auto",),
+                   help="restore the newest checkpoint before training "
+                        "(bare flag = 'auto': async-checkpoint manifests "
+                        "and the orbax dir compared by step, newest "
+                        "wins; manifest resume refuses a mismatched "
+                        "config hash)")
+    p.add_argument("--chaos-plan", default=None, metavar="PLAN.json",
+                   help="resilience fault-injection plan (JSON list of "
+                        "{kind, at, target, duration_s} — "
+                        "resilience/chaos.py fault table; composes with "
+                        "--chaos N env crashes)")
     # Eval.
     p.add_argument("--eval-episodes", type=int, default=10)
     p.add_argument("--eval-stochastic", action="store_true",
@@ -380,8 +406,23 @@ def main(argv=None) -> int:
 
     agent = configs.make_agent(cfg, mesh=mesh)
 
+    # Checkpoint cadence/retention: flags override the preset fields
+    # (configs.ExperimentConfig resilience block).
+    if args.checkpoint_interval is None:
+        args.checkpoint_interval = cfg.checkpoint_interval
+    ck_keep = (
+        args.checkpoint_keep
+        if args.checkpoint_keep is not None
+        else cfg.checkpoint_keep
+    )
+    ck_seconds = (
+        args.checkpoint_seconds
+        if args.checkpoint_seconds is not None
+        else cfg.checkpoint_seconds
+    )
+
     checkpointer = (
-        Checkpointer(args.checkpoint_dir)
+        Checkpointer(args.checkpoint_dir, max_to_keep=ck_keep)
         if args.checkpoint_dir is not None
         else None
     )
@@ -426,6 +467,33 @@ def main(argv=None) -> int:
 
         env_factory = CrashingFactory(env_factory, crash_after=args.chaos)
 
+    # Resilience wiring (docs/RESILIENCE.md): the async checkpoint writer
+    # (crash-consistent interval saves + run manifests) and the chaos
+    # fault plan.
+    async_checkpointer = None
+    config_hash = None
+    if args.async_checkpoint:
+        if args.checkpoint_dir is None:
+            raise SystemExit("--async-checkpoint needs --checkpoint-dir")
+        from torched_impala_tpu.resilience import (
+            AsyncCheckpointer,
+            config_fingerprint,
+        )
+
+        config_hash = config_fingerprint(cfg)
+        async_checkpointer = AsyncCheckpointer(
+            args.checkpoint_dir,
+            keep=ck_keep,
+            interval_steps=args.checkpoint_interval,
+            interval_seconds=ck_seconds,
+            config_hash=config_hash,
+        )
+    chaos_plan = None
+    if args.chaos_plan:
+        from torched_impala_tpu.resilience import ChaosPlan
+
+        chaos_plan = ChaosPlan.from_json(args.chaos_plan)
+
     total_steps = (
         args.total_steps
         if args.total_steps is not None
@@ -463,6 +531,9 @@ def main(argv=None) -> int:
             checkpointer=checkpointer,
             checkpoint_interval=args.checkpoint_interval,
             resume=args.resume,
+            async_checkpointer=async_checkpointer,
+            config_hash=config_hash,
+            chaos=chaos_plan,
             max_actor_restarts=args.max_actor_restarts,
             envs_per_actor=cfg.envs_per_actor,
             actor_mode=cfg.actor_mode,
@@ -492,6 +563,8 @@ def main(argv=None) -> int:
         logger.close()
         if checkpointer is not None:
             checkpointer.close()
+        if async_checkpointer is not None:
+            async_checkpointer.close()
 
     recent = [r for _, r, _ in result.episode_returns[-100:]]
     mean_ret = float(np.mean(recent)) if recent else float("nan")

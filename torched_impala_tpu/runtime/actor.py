@@ -47,6 +47,7 @@ class Actor:
         on_episode_return: Optional[Callable[[int, float, int], None]] = None,
         device: Optional[jax.Device] = None,
         task: Optional[int] = None,
+        chaos: Optional[Callable[[int], None]] = None,
     ) -> None:
         """`device` pins the actor's policy step to a specific device —
         typically a host CPU device so env-paced single-step inference never
@@ -71,6 +72,7 @@ class Actor:
             on_episode_return=on_episode_return,
             device=device,
             tasks=None if task is None else [task],
+            chaos=chaos,
         )
 
     @property

@@ -344,6 +344,12 @@ class ProcessEnvPool:
         # that eventually consumes it.
         self._tracer = tracer if tracer is not None else get_recorder()
         self.trace_lineage = ""
+        # Chaos seam (resilience/chaos.py): when set, called with the pool
+        # once per dispatch (step_all wave / async submit) BEFORE commands
+        # go out — the injection point for kill_env_worker (SIGKILL a
+        # worker process mid-run) and delay_lane faults. One attribute
+        # check when unset; never set outside chaos runs.
+        self.chaos_hook = None
 
         n = num_workers * envs_per_worker
         obs_bytes = n * int(np.prod(self._obs_shape)) * self._obs_dtype.itemsize
@@ -609,6 +615,8 @@ class ProcessEnvPool:
             else np.zeros((n,), np.bool_)
         )
         events: List[Tuple[int, float, int]] = []
+        if self.chaos_hook is not None:
+            self.chaos_hook(self)
         self._act_lane[:] = np.asarray(actions, np.int32)
         # Workers whose command could not even be SENT (abrupt process
         # death between rounds — SIGKILL/OOM) repair through the same path
@@ -668,6 +676,8 @@ class ProcessEnvPool:
                 f"worker {w} already has a step in flight; wait_any() it "
                 "before submitting again"
             )
+        if self.chaos_hook is not None:
+            self.chaos_hook(self)
         sl = self._worker_slice(w)
         self._act_lane[sl] = np.asarray(actions, np.int32)
         try:

@@ -1670,6 +1670,30 @@ class Learner:
             state["popart_state"] = host_snapshot(self._popart_state)
         return state
 
+    def get_state_device(self) -> dict:
+        """`get_state`-shaped tree with ON-DEVICE clones instead of host
+        snapshots — the learner-thread half of an async checkpoint save.
+
+        `jnp.copy` dispatches an on-device copy and returns immediately
+        (no host sync), and the clones are fresh buffers the train step's
+        donation can never invalidate, so the resilience
+        AsyncCheckpointer's writer thread can `device_get` them at its
+        leisure while training continues (resilience/checkpointer.py)."""
+        from torched_impala_tpu.utils.checkpoint import pack_rng
+
+        state = {
+            "params": jax.tree.map(jnp.copy, self._params),
+            "opt_state": jax.tree.map(jnp.copy, self._opt_state),
+            "num_frames": np.asarray(self.num_frames, np.int64),
+            "num_steps": np.asarray(self.num_steps, np.int64),
+            "rng": jnp.copy(pack_rng(self._rng)),
+        }
+        if self._config.popart is not None:
+            state["popart_state"] = jax.tree.map(
+                jnp.copy, self._popart_state
+            )
+        return state
+
     def set_state(self, state: Mapping[str, Any]) -> None:
         """Restore from `get_state()`-shaped tree and republish params so
         actors immediately see the restored policy at its restored frame

@@ -55,7 +55,10 @@ def train(
     mesh=None,
     checkpointer=None,
     checkpoint_interval: int = 0,
-    resume: bool = False,
+    resume=False,
+    async_checkpointer=None,
+    config_hash: Optional[str] = None,
+    chaos=None,
     max_actor_restarts: Optional[int] = 10,
     envs_per_actor: int = 1,
     actor_mode: str = "thread",
@@ -82,6 +85,26 @@ def train(
     `checkpoint_interval` learner steps and at the end; `resume=True`
     restores the latest checkpoint before training (restoring the
     actor-visible param version too, SURVEY.md §6 checkpoint row).
+
+    Resilience (docs/RESILIENCE.md):
+    - `async_checkpointer` (a `resilience.AsyncCheckpointer`) takes over
+      the INTERVAL saves: the post-step hook hands an on-device state
+      clone to its background writer (atomic tmp+fsync+rename + run
+      manifest + retention) so the train loop never blocks on disk; a
+      final manifest save lands at clean shutdown. May combine with
+      `checkpointer` (orbax then only writes the final checkpoint, which
+      keeps `--mode eval` readable).
+    - `resume="auto"` (or True) restores the newest state available:
+      the async checkpointer's manifests and the orbax dir are compared
+      by step and the newer wins. Manifest resume verifies `config_hash`
+      (resilience.config_fingerprint of the experiment config) and
+      REFUSES a mismatch with a clear error; the learner's `set_state`
+      then republishes params at the restored version so actors and the
+      trajectory ring resynchronize cleanly.
+    - `chaos` (a `resilience.ChaosPlan` or `ChaosInjector`) arms the
+      fault-injection harness: its hooks ride the env pools, the actors'
+      unroll starts, the trajectory enqueue, the learner post-step, and
+      the checkpoint writer (resilience/chaos.py fault table).
 
     `actor_mode` selects how env stepping escapes Python:
     - "thread": `num_actors` actor threads in this process, each stepping
@@ -189,6 +212,23 @@ def train(
             with logger_lock:
                 logger(merged)
 
+    # Chaos harness (resilience/chaos.py): accept a plan or a prebuilt
+    # injector; hooks attach to every stage built below.
+    injector = None
+    if chaos is not None:
+        from torched_impala_tpu.resilience.chaos import (
+            ChaosInjector,
+            ChaosPlan,
+        )
+
+        if isinstance(chaos, ChaosInjector):
+            injector = chaos
+        else:
+            plan = chaos if isinstance(chaos, ChaosPlan) else ChaosPlan(chaos)
+            injector = ChaosInjector(plan)
+        if async_checkpointer is not None:
+            async_checkpointer._post_save = injector.checkpoint_hook
+
     learner = Learner(
         agent=agent,
         optimizer=optimizer,
@@ -198,13 +238,54 @@ def train(
         logger=learner_logger,
         mesh=mesh,
     )
-    if resume and checkpointer is not None:
-        restored = checkpointer.restore(learner.get_state())
+    if resume:
+        # Newest state wins across backends: the async checkpointer's
+        # manifests (crash-consistent interval saves) vs the orbax dir
+        # (final saves of completed runs). Manifest resume is config-
+        # hash-guarded (resilience/recovery.py refuses a mismatch).
+        restored = None
+        restored_step = -1
+        if async_checkpointer is not None:
+            from torched_impala_tpu.resilience import recovery
+
+            found = recovery.restore_latest(
+                async_checkpointer.directory,
+                learner.get_state(),
+                config_hash=config_hash,
+            )
+            if found is not None:
+                manifest, restored = found
+                restored_step = manifest.step
+                print(
+                    f"[resume] manifest @ step {manifest.step} "
+                    f"(param_version {manifest.param_version}) from "
+                    f"{async_checkpointer.directory}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        if checkpointer is not None:
+            orbax_step = checkpointer.latest_step()
+            if orbax_step is not None and orbax_step > restored_step:
+                orbax_restored = checkpointer.restore(learner.get_state())
+                if orbax_restored is not None:
+                    restored = orbax_restored
         if restored is not None:
             learner.set_state(restored)
 
     post_hooks: list = []
-    if checkpointer is not None and checkpoint_interval > 0:
+    if async_checkpointer is not None:
+        # Interval saves go through the background writer: the post-step
+        # hook only clones state on-device (get_state_device, no host
+        # sync) when a save is due — the train loop never blocks on disk.
+        def _async_checkpoint_hook(num_steps: int) -> None:
+            async_checkpointer.maybe_save(
+                num_steps,
+                learner.get_state_device,
+                param_version=learner.num_frames,
+            )
+
+        post_hooks.append(_async_checkpoint_hook)
+    elif checkpointer is not None and checkpoint_interval > 0:
         last_saved = [learner.num_steps]
 
         def _checkpoint_hook(num_steps: int) -> None:
@@ -215,6 +296,8 @@ def train(
                 last_saved[0] = num_steps
 
         post_hooks.append(_checkpoint_hook)
+    if injector is not None:
+        post_hooks.append(injector.learner_hook)
     if on_learner_step is not None:
         post_hooks.append(on_learner_step)
         # Fire once with the CURRENT (possibly restored) step count so a
@@ -318,6 +401,16 @@ def train(
                     f"column blocks of one batch slot)"
                 )
 
+    # Chaos wiring: the enqueue seam (wedge_queue) and the per-unroll
+    # actor seam ride every actor; the pool seam rides every pool.
+    enqueue = learner.enqueue
+    actor_chaos = None
+    if injector is not None:
+        enqueue = injector.wrap_enqueue(learner.enqueue)
+        actor_chaos = injector.actor_hook
+        for pool in env_pools:
+            pool.chaos_hook = injector.pool_hook
+
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the
         # published params, so restart-after-crash just rebuilds the envs.
@@ -326,11 +419,12 @@ def train(
             actor_id=slot,
             agent=agent,
             param_store=learner.param_store,
-            enqueue=learner.enqueue,
+            enqueue=enqueue,
             unroll_length=learner_config.unroll_length,
             seed=base_seed,
             on_episode_return=on_episode_return,
             device=device,
+            chaos=actor_chaos,
         )
         if env_pools:
             # One batched-inference actor per pool; pools repair their own
@@ -440,6 +534,17 @@ def train(
         for pool in env_pools:
             pool.close()
 
+    # Final saves land only on a CLEAN finish — an exception above (a real
+    # crash or a chaos crash_learner fault) propagates past this point, so
+    # resume starts from the last INTERVAL checkpoint, exactly like a
+    # process death.
+    if async_checkpointer is not None:
+        async_checkpointer.save_now(
+            learner.num_steps,
+            learner.get_state(),
+            param_version=learner.num_frames,
+        )
+        async_checkpointer.wait()
     if checkpointer is not None:
         checkpointer.save(learner.num_steps, learner.get_state())
         checkpointer.wait()

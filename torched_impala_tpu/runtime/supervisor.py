@@ -17,11 +17,13 @@ and telemetry.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, List, Optional
 
 from torched_impala_tpu.runtime.actor import Actor
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
 
 
 class ActorSupervisor:
@@ -42,8 +44,17 @@ class ActorSupervisor:
         max_restarts_per_actor: Optional[int] = 10,
         backoff_base: float = 0.5,
         backoff_max: float = 30.0,
+        backoff_jitter: float = 0.25,
+        jitter_seed: Optional[int] = None,
         on_restart: Optional[Callable[[int, BaseException], None]] = None,
+        telemetry: Optional[Registry] = None,
     ) -> None:
+        """`backoff_jitter` widens each backoff by a uniform factor in
+        [1, 1 + jitter]: deterministic exponential delays synchronize a
+        fleet of crash-looping slots into restart THUNDERING HERDS (every
+        slot rebuilds its env at the same instant, stampeding the env
+        backend / shared host resources); jitter decorrelates them.
+        `jitter_seed` pins the jitter stream for tests."""
         self._make_actor = make_actor
         self._num = num_actors
         self._stop = stop_event
@@ -51,7 +62,16 @@ class ActorSupervisor:
         self._max_restarts = max_restarts_per_actor
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
+        if backoff_jitter < 0:
+            raise ValueError(f"backoff_jitter must be >= 0, got {backoff_jitter}")
+        self._backoff_jitter = backoff_jitter
+        self._jitter_rng = random.Random(jitter_seed)
         self._on_restart = on_restart
+        reg = telemetry if telemetry is not None else get_registry()
+        # The resilience view of fleet health (docs/RESILIENCE.md): a
+        # climbing counter here with flat env-pool restarts means actor-
+        # side crashes (policy/unroll path), not env-worker deaths.
+        self._m_restarts = reg.counter("resilience/supervisor_restarts")
 
         self.actors: List[Actor] = []
         self._threads: List[threading.Thread] = []
@@ -118,9 +138,17 @@ class ActorSupervisor:
             self._restarting[slot] = True
             self._restart_counts[slot] += 1
             self.restarts += 1
+            self._m_restarts.inc()
+            # Exponential backoff with jitter: the exponent caps the
+            # retry rate of one crash-looping slot; the jitter factor
+            # (uniform in [1, 1+j]) decorrelates MANY slots crashing on a
+            # shared cause so their env rebuilds don't stampede in
+            # lockstep every 2^k seconds.
             backoff = min(
                 self._backoff_max,
-                self._backoff_base * (2 ** (self._restart_counts[slot] - 1)),
+                self._backoff_base
+                * (2 ** (self._restart_counts[slot] - 1))
+                * (1.0 + self._backoff_jitter * self._jitter_rng.random()),
             )
             self._next_restart_at[slot] = now + backoff
         # Callbacks and actor construction run OUTSIDE the lock (they do

@@ -93,6 +93,7 @@ class VectorActor:
         telemetry: Optional[Registry] = None,
         traj_ring: Optional[TrajectoryRing] = None,
         tracer: Optional[FlightRecorder] = None,
+        chaos: Optional[Callable[[int], None]] = None,
     ) -> None:
         """`tasks` overrides the per-env task ids (default: each env's
         `task_id` attribute, else 0). `device` pins policy inference — see
@@ -145,6 +146,11 @@ class VectorActor:
         self._tracer = tracer if tracer is not None else get_recorder()
         self._unroll_seq = 0
         self._lid = ""
+        # Chaos seam (resilience/chaos.py): called with actor_id at each
+        # unroll start; a raise_in_actor fault raises ChaosError here —
+        # the error records on this actor and the supervisor restarts the
+        # slot, exactly the real-crash path.
+        self._chaos = chaos
 
         if hasattr(envs, "step_all"):  # batched env (ProcessEnvPool)
             self._pool = envs
@@ -322,6 +328,8 @@ class VectorActor:
         whole cycle as an `actor/unroll` flight-recorder span stamped
         with the acting param version; every downstream stage that
         touches the unroll's bytes reuses the ID."""
+        if self._chaos is not None:
+            self._chaos(self._id)
         self._lid = lid = mint_lineage_id(self._id, self._unroll_seq)
         self._unroll_seq += 1
         if self._pool is not None:

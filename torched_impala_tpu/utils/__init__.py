@@ -2,7 +2,11 @@
 
 from torched_impala_tpu.utils.checkpoint import (
     Checkpointer,
+    CheckpointCorruptError,
+    atomic_write_bytes,
+    load_state_file,
     pack_rng,
+    save_state_file,
     unpack_rng,
 )
 from torched_impala_tpu.utils.loggers import (
@@ -17,7 +21,11 @@ from torched_impala_tpu.utils.loggers import (
 
 __all__ = [
     "Checkpointer",
+    "CheckpointCorruptError",
+    "atomic_write_bytes",
+    "load_state_file",
     "pack_rng",
+    "save_state_file",
     "unpack_rng",
     "CSVLogger",
     "JSONLinesLogger",

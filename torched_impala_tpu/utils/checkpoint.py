@@ -36,6 +36,119 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file failed to load because its bytes are damaged
+    (truncated write, bit rot, or a concurrent writer that skipped the
+    atomic tmp+fsync+rename protocol). The message names the file; the
+    resilience recovery path reacts by falling back to the previous
+    retained checkpoint (resilience/recovery.py)."""
+
+
+def fsync_directory(directory: str) -> None:
+    """fsync a directory so a just-renamed file's directory entry is
+    durable — os.replace is atomic against readers but the rename itself
+    can still be lost on power failure without this."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds; rename stays atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write `data` to `path` atomically: tmp file in the same directory,
+    flush + fsync, then os.replace. Readers never observe a partial file —
+    the crash-consistency primitive every resilience artifact (state
+    files, run manifests) is written through."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_directory(directory)
+
+
+def _flat_state(state: Any) -> dict:
+    """Flatten a state pytree into {keystr: host ndarray} — the .npz
+    entry map of `save_state_file`."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    out = {}
+    for path, leaf in flat:
+        if jnp_issubdtype_prng(leaf):
+            leaf = jax.random.key_data(leaf)
+        out[jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return out
+
+
+def save_state_file(path: str, state: Any) -> int:
+    """Serialize a state pytree (arrays / scalars) to ONE `.npz` file with
+    an atomic tmp + fsync + rename write; returns the bytes written.
+
+    The resilience AsyncCheckpointer's on-disk format: a crash mid-save
+    leaves at most an ignorable `.tmp.*` file, never a half-written
+    checkpoint, so the newest complete file is always loadable (backed by
+    the zip CRCs `load_state_file` verifies)."""
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **_flat_state(state))
+    data = buf.getvalue()
+    atomic_write_bytes(path, data)
+    return len(data)
+
+
+def load_state_file(path: str, target: Any) -> Any:
+    """Load a `save_state_file` checkpoint into `target`'s structure.
+
+    `target` supplies the pytree structure (and the shapes the restored
+    leaves are validated against); its leaves may be live arrays or
+    `jax.ShapeDtypeStruct`s. Raises `CheckpointCorruptError` with the
+    offending path when the file is truncated, fails its zip CRCs, or is
+    missing entries the target requires — the clear-error contract the
+    recovery scan relies on to fall back to an older checkpoint."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            available = set(data.files)
+            leaves = []
+            for keypath, leaf in flat:
+                key = jax.tree_util.keystr(keypath)
+                if key not in available:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {path} is missing entry {key!r} "
+                        f"(has {sorted(available)[:6]}...); the file is "
+                        "corrupt or was written by an incompatible "
+                        "config — resume from an earlier checkpoint"
+                    )
+                # Reading the entry verifies its zip CRC: byte-level
+                # corruption surfaces HERE, not as garbage params.
+                leaves.append(data[key])
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is corrupt or truncated "
+            f"({type(e).__name__}: {e}); delete it and resume from an "
+            "earlier retained checkpoint (docs/RESILIENCE.md)"
+        ) from e
+    restored = jax.tree_util.tree_unflatten(treedef, leaves)
+    validate_restored_shapes(restored, target, what="checkpoint")
+    return restored
+
+
 def pack_rng(rng: jax.Array) -> jax.Array:
     """Typed PRNG key -> raw uint32 key data (checkpoint-safe)."""
     if jnp_issubdtype_prng(rng):
