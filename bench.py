@@ -291,6 +291,10 @@ def main(args) -> None:
     # env worker + crashed actor + crashed learner -> resume reaches the
     # target step count; async checkpoint overhead < 1%).
     section("chaos", lambda: run_bench_chaos(jax))
+    # Host-side: serving tier (ISSUE 6 acceptance: coalesced batching
+    # >= 3x per-request actions/s at 64 clients, shadow traffic <= 5%
+    # primary-wave latency, bf16 passes the greedy parity gate).
+    section("serving", lambda: run_bench_serving(jax))
     section("e2e_components", lambda: run_e2e_components(jax))
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
@@ -2161,6 +2165,166 @@ def run_bench_chaos(jax, tiny: bool = False) -> dict:
         per_save_s / (100.0 / sps_off) * 100.0, 4
     )
     log(f"bench: chaos: {out}")
+    return out
+
+
+def run_bench_serving(jax, tiny: bool = False) -> dict:
+    """Serving-tier bench (ISSUE 6 acceptance): coalesced continuous
+    batching vs per-request inference at 64 concurrent clients, shadow
+    traffic cost, and the bf16 greedy-parity gate.
+
+    Protocol: 64 clients drive the SAME PolicyServer surface in rounds —
+    every client submits one async request, then all responses are
+    awaited (one driver thread models the concurrent fleet without
+    spawning 64 OS threads on a 1-core box; the server sees 64
+    simultaneously-outstanding requests either way, which is what
+    coalescing batches over). Arms:
+      per_request: max_batch=1 — every request is its own wave (the
+        per-actor-inference shape the serving tier replaces);
+      coalesced:   max_batch=64 — one padded wave per round;
+      shadow:      coalesced + a shadow label scoring every sampled wave
+        on the best-effort background thread (actions logged, never
+        returned — drop-when-busy keeps the primary path unblocked).
+
+    Claims pinned by tests/test_bench_units.py on the tiny variant:
+    coalesced >= 3x per-request aggregate actions/s; shadow latency
+    overhead on primary waves bounded (<= 5% is the artifact target on
+    an idle multi-core host; the CI assert keeps 1-core/GIL slack, same
+    convention as the chaos/tracing sections); bf16 greedy parity holds.
+    """
+    import numpy as np
+
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.runtime.param_store import ParamStore
+    from torched_impala_tpu.serving import (
+        InProcessClient,
+        PolicyServer,
+        VersionRegistry,
+        greedy_action_parity,
+    )
+    from torched_impala_tpu.telemetry import Registry
+
+    C = 64  # concurrent clients (the acceptance-criteria fleet size)
+    rounds = 4 if tiny else 30
+    obs_dim = 8
+    agent = Agent(
+        ImpalaNet(num_actions=6, torso=MLPTorso(hidden_sizes=(64,)))
+    )
+    params = agent.init_params(
+        jax.random.key(0), np.zeros((obs_dim,), np.float32)
+    )
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(C, obs_dim)).astype(np.float32)
+
+    def measure(max_batch: int, shadow: bool):
+        reg = Registry()
+        store = ParamStore()
+        store.publish(0, params)
+        registry = VersionRegistry(store, telemetry=reg)
+        registry.pin("live", 0)
+        if shadow:
+            # Same params under a second label: the cost arm measures
+            # shadow COMPUTE, not a different policy.
+            registry.pin("shadow", 0)
+            registry.set_routing(
+                {"live": 1.0}, shadow="shadow", shadow_fraction=1.0
+            )
+        else:
+            registry.set_routing({"live": 1.0})
+        server = PolicyServer(
+            agent=agent,
+            registry=registry,
+            example_obs=np.zeros((obs_dim,), np.float32),
+            max_clients=C,
+            max_batch=max_batch,
+            max_wait_s=5e-3,
+            telemetry=reg,
+        ).start()
+        try:
+            clients = [InProcessClient(server, greedy=True)
+                       for _ in range(C)]
+            def round_trip(first: bool) -> None:
+                cells = [
+                    c.act_async(obs[i], first)
+                    for i, c in enumerate(clients)
+                ]
+                for cell in cells:
+                    cell.result(timeout=120.0)
+            round_trip(True)  # warmup: compiles the wave shape
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                round_trip(False)
+            dt = time.perf_counter() - t0
+            for c in clients:
+                c.close()
+        finally:
+            server.close()
+        snap = reg.snapshot()
+        return {
+            "actions_per_sec": round(C * rounds / dt, 1),
+            "wave_ms_p50": round(
+                float(snap["telemetry/serving/wave_ms_p50"]), 3
+            ),
+            "wave_ms_p95": round(
+                float(snap["telemetry/serving/wave_ms_p95"]), 3
+            ),
+            "waves": int(snap["telemetry/serving/wave_total"]),
+            "wave_size_p50": round(
+                float(snap["telemetry/serving/wave_size_p50"]), 1
+            ),
+            "shadow_scored": int(snap["telemetry/serving/shadow_total"]),
+            "shadow_skipped": int(
+                snap["telemetry/serving/shadow_skipped"]
+            ),
+            "shadow_mismatches": int(
+                snap["telemetry/serving/shadow_mismatch"]
+            ),
+        }
+
+    per_request = measure(max_batch=1, shadow=False)
+    coalesced = measure(max_batch=C, shadow=False)
+    shadowed = measure(max_batch=C, shadow=True)
+    parity_ok, mismatches = greedy_action_parity(agent, params, obs)
+    out = {
+        "clients": C,
+        "rounds": rounds,
+        "per_request": per_request,
+        "coalesced": coalesced,
+        "shadow": shadowed,
+        "coalesced_speedup": round(
+            coalesced["actions_per_sec"]
+            / max(per_request["actions_per_sec"], 1e-9),
+            2,
+        ),
+        "shadow_latency_overhead_pct": round(
+            (
+                shadowed["wave_ms_p50"]
+                / max(coalesced["wave_ms_p50"], 1e-9)
+                - 1.0
+            )
+            * 100.0,
+            2,
+        ),
+        "shadow_throughput_overhead_pct": round(
+            (
+                1.0
+                - shadowed["actions_per_sec"]
+                / max(coalesced["actions_per_sec"], 1e-9)
+            )
+            * 100.0,
+            2,
+        ),
+        "bf16_parity": parity_ok,
+        "bf16_mismatches": mismatches,
+    }
+    log(
+        f"bench: serving: {out['coalesced_speedup']}x coalesced vs "
+        f"per-request at {C} clients "
+        f"({coalesced['actions_per_sec']} vs "
+        f"{per_request['actions_per_sec']} actions/s), shadow latency "
+        f"+{out['shadow_latency_overhead_pct']}%, bf16 parity "
+        f"{parity_ok}"
+    )
     return out
 
 

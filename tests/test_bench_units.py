@@ -137,6 +137,31 @@ def test_chaos_bench_recovers_with_bounded_overhead(jax_cpu):
     assert out["checkpoint_overhead_pct"] < 5.0, out
 
 
+def test_serving_bench_coalescing_shadow_and_parity(jax_cpu):
+    """The ISSUE 6 acceptance bounds, wired into CI via the bench serving
+    section's tiny variant: at 64 concurrent clients, coalesced
+    continuous batching must beat per-request inference by >= 3x
+    aggregate actions/s (measured ~5x on this 1-core box; the gap only
+    widens with cores/accelerators since per-request pays per-dispatch
+    overhead 64x per round); shadow traffic must not meaningfully add
+    latency to primary waves (artifact target <= 5% on an idle host —
+    the drop-when-busy background scorer never blocks the primary path;
+    the CI assert keeps 1-core GIL-contention slack, same convention as
+    the chaos/tracing bounds); and bf16-cast serving params must pass
+    the f32 greedy-action parity gate exactly."""
+    from bench import run_bench_serving
+
+    out = run_bench_serving(jax_cpu, tiny=True)
+    assert out["clients"] == 64
+    assert out["coalesced_speedup"] >= 3.0, out
+    assert out["shadow_latency_overhead_pct"] <= 25.0, out
+    # Shadow really scored waves (the overhead number measured work, not
+    # an idle thread) and identical shadow params never mismatch.
+    assert out["shadow"]["shadow_scored"] > 0, out
+    assert out["shadow"]["shadow_mismatches"] == 0, out
+    assert out["bf16_parity"], out
+
+
 def test_tracing_bench_overhead_bound(jax_cpu):
     """The ISSUE 4 acceptance bound, wired into CI via the bench
     section's tiny variant: the flight recorder stays negligible with

@@ -22,6 +22,11 @@ then asserts:
    three-segment names) use a pinned sub-family prefix
    (`checkpoint_`/`supervisor_`/`chaos_`/`recovery_`), so the family
    stays greppable as `resilience/checkpoint_*` etc.;
+3c. `serving/*` metric names (ISSUE 6) use the same discipline with the
+   serving sub-families (`request_`/`wave_`/`shadow_`/`client_`/
+   `version_`/`ring_`) — dashboards glob `serving/request_*` for the
+   client-visible latency story and `serving/wave_*` for the device
+   side;
 4. every trace event name follows the SAME `<component>/<name>` grammar
    (the recorder enforces it at runtime too; trace components map to
    Chrome-trace process rows, so a malformed name breaks the Perfetto
@@ -29,6 +34,11 @@ then asserts:
    instant and complete — only recorder-vs-METRIC grammar is shared,
    `.span("...")` sites (registry or recorder) both count as the timer
    series by design.
+4b. `serving/...` TRACE events are a closed set — `serving/request`
+   (submit→response, args {lid: c<slot>r<seq>, version, wave}),
+   `serving/wave` and `serving/shadow` — because trace consumers (the
+   lineage tooling, Perfetto queries in docs/SERVING.md) key on these
+   exact names; a new serving span must be added here AND documented.
 
 Static on purpose: the lint runs from the test suite
 (tests/test_telemetry.py) on every CI pass without spawning pools or
@@ -76,6 +86,19 @@ _CANONICAL = {"span": "timer"}
 # aggregates checkpointing, supervision, chaos, and recovery series, and
 # an unprefixed name would orphan itself from every dashboard glob.
 RESILIENCE_PREFIXES = ("checkpoint_", "supervisor_", "chaos_", "recovery_")
+
+# serving/<name> sub-families (rule 3c): request-side, wave-side, shadow
+# scoring, client bookkeeping, version routing, and the shm ring.
+SERVING_PREFIXES = (
+    "request_", "wave_", "shadow_", "client_", "version_", "ring_",
+)
+
+# The closed serving trace-event set (rule 4b): the `serving/request`
+# span grammar (args {lid, version, wave}) is part of the serving
+# contract; consumers match these names literally.
+SERVING_TRACE_EVENTS = {
+    "serving/request", "serving/wave", "serving/shadow",
+}
 
 
 def _py_files(root: str) -> List[str]:
@@ -126,6 +149,15 @@ def check(root: str = REPO) -> List[str]:
                             f"{RESILIENCE_PREFIXES}"
                         )
                         continue
+                    if name.startswith("serving/") and not name.split(
+                        "/", 1
+                    )[1].startswith(SERVING_PREFIXES):
+                        errors.append(
+                            f"{site}: serving metric {name!r} must "
+                            f"use a sub-family prefix "
+                            f"{SERVING_PREFIXES}"
+                        )
+                        continue
                     prev = seen.get(name)
                     if prev is None:
                         seen[name] = (kind, site)
@@ -140,6 +172,16 @@ def check(root: str = REPO) -> List[str]:
                             f"{site}: trace {kind} name {name!r} does "
                             f"not match <component>/<name> "
                             f"({NAME_RE.pattern})"
+                        )
+                        continue
+                    if (
+                        name.startswith("serving/")
+                        and name not in SERVING_TRACE_EVENTS
+                    ):
+                        errors.append(
+                            f"{site}: serving trace event {name!r} is "
+                            f"not in the pinned set "
+                            f"{sorted(SERVING_TRACE_EVENTS)} (rule 4b)"
                         )
                 for m in _LITERAL_KEY.finditer(line):
                     if not NAME_RE.match(m.group(1)):

@@ -162,6 +162,17 @@ class ExperimentConfig:
     checkpoint_interval: int = 1000
     checkpoint_keep: int = 3
     checkpoint_seconds: float = 0.0
+    # Serving tier (torched_impala_tpu/serving/, docs/SERVING.md): the
+    # batched-inference service parameters used when eval (or a serving
+    # fleet) routes policy requests through a PolicyServer.
+    # `serving_max_batch` is the padded wave width (ONE compiled shape);
+    # `serving_wait_ms` the coalescing window (a wave launches when
+    # max_batch distinct clients wait OR the oldest request ages this
+    # much); `serving_dtype` opts serving into bf16-cast params — gated
+    # on the f32 greedy-action parity check (serving.greedy_action_parity).
+    serving_max_batch: int = 32
+    serving_wait_ms: float = 2.0
+    serving_dtype: str = "float32"
     # Flight-recorder export (telemetry/tracing.py): write the retained
     # trace events — per-unroll lineage IDs threaded env→pool→queue/
     # ring→learner with exact per-batch param lag — as Chrome-trace

@@ -11,9 +11,11 @@ Checks, in order:
    --platform choice; a wedged TPU tunnel surfaces here, not mid-run);
    then telemetry registry, flight-recorder trace round-trip (a 2-event
    Chrome-trace export under traces/ reloaded + schema-validated),
-   trajectory-ring spec checks, and the resilience self-check (atomic
+   trajectory-ring spec checks, the resilience self-check (atomic
    checkpoint + manifest round-trip, corrupted-copy rejection,
-   config-hash resume refusal);
+   config-hash resume refusal), and the serving self-check (PolicyServer
+   + in-process clients, one batched wave vs direct agent.step parity,
+   bf16 greedy-parity gate);
 3. per-family env contract: construct the REAL factory, reset, step a
    random policy N steps, validate the (obs, reward, terminated,
    truncated, info) surface, dtypes and shapes against the factory's
@@ -342,6 +344,87 @@ def _check_resilience() -> tuple[str, str]:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _check_serving(seed: int = 0) -> tuple[str, str]:
+    """Serving-tier self-check (docs/SERVING.md): spin up a PolicyServer
+    over a fresh ParamStore, connect in-process clients, drive ONE
+    batched wave deterministically (service_once), and verify every
+    served action equals the direct `agent.step` greedy argmax at the
+    same params — plus the bf16 greedy-parity gate the bf16 serving
+    path is gated on. Purely local: tiny MLP agent, no threads beyond
+    the construction path, no pools."""
+    import numpy as np
+
+    try:
+        import jax
+
+        from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+        from torched_impala_tpu.runtime.param_store import ParamStore
+        from torched_impala_tpu.serving import (
+            InProcessClient,
+            PolicyServer,
+            VersionRegistry,
+            greedy_action_parity,
+        )
+
+        agent = Agent(
+            ImpalaNet(num_actions=4, torso=MLPTorso(hidden_sizes=(32,)))
+        )
+        example = np.zeros((8,), np.float32)
+        params = agent.init_params(jax.random.key(seed), example)
+        store = ParamStore()
+        store.publish(0, params)
+        registry = VersionRegistry.serving_latest(store)
+        server = PolicyServer(
+            agent=agent,
+            registry=registry,
+            example_obs=example,
+            max_clients=4,
+            max_batch=4,
+            max_wait_s=0.0,
+        )
+        try:
+            clients = [InProcessClient(server, greedy=True)
+                       for _ in range(3)]
+            rng = np.random.default_rng(seed)
+            obs = rng.normal(size=(3, 8)).astype(np.float32)
+            cells = [
+                c.act_async(obs[i], True) for i, c in enumerate(clients)
+            ]
+            served = server.service_once()
+            assert served == 3, f"one wave should answer 3 reqs, got {served}"
+            results = [cell.result(timeout=10.0) for cell in cells]
+            waves = {r.wave for r in results}
+            assert len(waves) == 1, f"expected ONE wave, got {waves}"
+            out = agent.step(
+                params,
+                jax.random.key(0),
+                obs,
+                np.ones((3,), np.bool_),
+                agent.initial_state(3),
+            )
+            direct = np.argmax(np.asarray(out.policy_logits), axis=-1)
+            got = np.asarray([r.action for r in results])
+            assert np.array_equal(got, direct), (got, direct)
+            parity_ok, mismatches = greedy_action_parity(
+                agent, params, obs
+            )
+            if not parity_ok:
+                return "FAIL", (
+                    f"bf16 greedy parity gate: {mismatches} mismatched "
+                    "actions vs f32"
+                )
+            for c in clients:
+                c.close()
+        finally:
+            server.close()
+        return "ok", (
+            "one batched wave (3 clients) matches direct agent.step "
+            "argmax; bf16 greedy parity gate passes"
+        )
+    except Exception:
+        return "FAIL", f"serving tier broken:\n{traceback.format_exc()}"
+
+
 def _train_probe(config_name: str) -> tuple[str, str]:
     """Two real learner steps through the full runtime on the preset's
     REAL envs (no fakes) — the end-to-end first-contact check."""
@@ -443,6 +526,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_resilience()
     print(f"  resilience [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_serving()
+    print(f"  serving    [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

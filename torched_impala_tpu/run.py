@@ -166,6 +166,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "--chaos N env crashes)")
     # Eval.
     p.add_argument("--eval-episodes", type=int, default=10)
+    p.add_argument("--eval-serving", action="store_true",
+                   help="route eval inference through the serving tier "
+                        "(PolicyServer + in-process client, "
+                        "torched_impala_tpu/serving/): continuous-batched "
+                        "waves, versioned params, serving/* telemetry — "
+                        "greedy eval returns are identical to the direct "
+                        "path (docs/SERVING.md)")
+    p.add_argument("--serve-dtype", choices=("float32", "bfloat16"),
+                   default=None,
+                   help="serving-path param dtype (default: preset's "
+                        "serving_dtype). bfloat16 is refused unless the "
+                        "f32 greedy-action parity gate passes on this "
+                        "checkpoint (docs/SERVING.md bf16 policy)")
     p.add_argument("--eval-stochastic", action="store_true",
                    help="sample actions instead of argmax")
     p.add_argument("--eval-max-steps", type=int, default=108_000,
@@ -754,6 +767,84 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
 
     env_factory = configs.make_env_factory(cfg, fake=args.fake_envs)
     max_steps = args.eval_max_steps if args.eval_max_steps > 0 else None
+    if args.eval_serving:
+        # Serving-tier eval (docs/SERVING.md): the evaluator is the
+        # serving tier's first client — identical greedy returns to the
+        # direct path, but the inference rides PolicyServer waves with
+        # serving/* telemetry and versioned provenance.
+        if args.eval_parallel > 1:
+            raise SystemExit(
+                "--eval-serving batches inside the server; it composes "
+                "with the serial evaluator only (drop --eval-parallel)"
+            )
+        from torched_impala_tpu.runtime.param_store import ParamStore
+        from torched_impala_tpu.serving import (
+            InProcessClient,
+            PolicyServer,
+            VersionRegistry,
+            greedy_action_parity,
+        )
+
+        serve_dtype = args.serve_dtype or cfg.serving_dtype
+        if serve_dtype == "bfloat16":
+            rng = np.random.default_rng(args.seed)
+            example = configs.example_obs(cfg)
+            if example.dtype == np.uint8:
+                probe = rng.integers(
+                    0, 256, size=(8, *example.shape), dtype=np.uint8
+                )
+            else:
+                probe = rng.normal(size=(8, *example.shape)).astype(
+                    example.dtype
+                )
+            ok, mismatches = greedy_action_parity(agent, params, probe)
+            if not ok:
+                print(
+                    f"error: bf16 serving refused — greedy-action parity "
+                    f"gate failed ({mismatches}/8 probe actions differ "
+                    "from f32); serve in float32 or retrain "
+                    "(docs/SERVING.md bf16 policy)",
+                    file=sys.stderr,
+                )
+                return 5
+        store = ParamStore()
+        store.publish(0, params)
+        registry = VersionRegistry.serving_latest(store)
+        server = PolicyServer(
+            agent=agent,
+            registry=registry,
+            example_obs=configs.example_obs(cfg),
+            max_clients=4,
+            max_batch=min(4, cfg.serving_max_batch),
+            max_wait_s=cfg.serving_wait_ms / 1e3,
+            dtype=serve_dtype,
+            seed=args.seed,
+        ).start()
+        env = env_factory(args.seed + 777_000)
+        try:
+            with InProcessClient(
+                server, greedy=not args.eval_stochastic
+            ) as client:
+                result = run_episodes(
+                    env=env,
+                    num_episodes=args.eval_episodes,
+                    greedy=not args.eval_stochastic,
+                    seed=args.seed,
+                    max_steps_per_episode=max_steps,
+                    client=client,
+                )
+        finally:
+            server.close()
+            close = getattr(env, "close", None)
+            if close is not None:
+                close()
+        print(
+            f"eval: episodes={len(result.returns)} "
+            f"mean_return={result.mean_return:.2f} "
+            f"mean_length={result.mean_length:.1f} "
+            f"(serving path, dtype={serve_dtype})"
+        )
+        return 0
     if args.eval_parallel > 1:
         from torched_impala_tpu.runtime.evaluator import (
             run_episodes_batched,

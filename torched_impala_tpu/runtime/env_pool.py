@@ -104,8 +104,15 @@ except ValueError:  # platform without forkserver
         pass
 
 
-def _align(offset: int, to: int = 8) -> int:
+def align(offset: int, to: int = 8) -> int:
+    """Round `offset` up to a multiple of `to` — the shm-lane layout
+    helper shared by this pool and the serving request ring
+    (serving/shm_ring.py), which reuses the same one-segment/typed-lane
+    pattern for its request/response slots."""
     return (offset + to - 1) // to * to
+
+
+_align = align  # internal alias (layout call sites below)
 
 
 def _worker_main(

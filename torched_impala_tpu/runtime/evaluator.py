@@ -33,7 +33,22 @@ class EvalResult:
         return float(np.mean(self.lengths)) if self.lengths else float("nan")
 
 
-@functools.lru_cache(maxsize=None)
+# BOUNDED per-(agent, greedy) cache, NOT an unbounded lru_cache: the old
+# `lru_cache(maxsize=None)` keyed on Agent instances held a strong
+# reference to every agent ever evaluated — a test suite / sweep
+# building fresh agents leaked each one AND its jitted executables for
+# the life of the process. A weak cache cannot work here (the jitted fn
+# closes over the agent, so the cache VALUE would pin its key alive);
+# bounding the LRU caps the retention at the `maxsize` most recent
+# (agent, greedy) pairs instead — evicted agents (and their compiled
+# programs) become collectable. Equal agents (dataclass equality = same
+# static config) share one entry, so N evaluator calls on one config
+# still compile once. Regression-pinned in
+# tests/test_serving.py::TestEvalStepCache.
+_EVAL_STEP_CACHE_SIZE = 16
+
+
+@functools.lru_cache(maxsize=_EVAL_STEP_CACHE_SIZE)
 def _jitted_eval_step(agent: Agent, greedy: bool):
     def _step(params, key, obs, first, state):
         key, sub = jax.random.split(key)
@@ -49,13 +64,14 @@ def _jitted_eval_step(agent: Agent, greedy: bool):
 
 def run_episodes(
     *,
-    agent: Agent,
-    params,
     env,
     num_episodes: int,
+    agent: Optional[Agent] = None,
+    params=None,
     greedy: bool = True,
     seed: int = 0,
     max_steps_per_episode: Optional[int] = 108_000,
+    client=None,
 ) -> EvalResult:
     """Play `num_episodes` full episodes; returns per-episode stats.
 
@@ -65,26 +81,49 @@ def run_episodes(
     `max_steps_per_episode` defaults to 108k env steps (the standard Atari
     30-minute cap) so a never-terminating policy or non-truncating env can't
     hang eval forever; pass None to remove the cap.
+
+    `client` routes policy inference through the serving tier instead of
+    a local `agent.step`: anything with an `act(obs, first) -> int`
+    surface (serving.InProcessClient, serving.ShmRingClient.act — the
+    evaluator is the serving tier's first client, ISSUE 6). The server
+    holds the recurrent state; `first=True` at each episode start resets
+    it, so the greedy client path produces IDENTICAL episode returns to
+    the direct path at the same params/seed (pinned in
+    tests/test_serving.py). With `client` set, `agent`/`params` are
+    unused and may be omitted; note a SAMPLED (greedy=False) client eval
+    draws from the server's RNG stream, not this function's `seed`.
     """
-    step_fn = _jitted_eval_step(agent, greedy)
-    key = jax.random.key(seed)
+    if client is None:
+        if agent is None or params is None:
+            raise ValueError(
+                "run_episodes needs agent+params (direct path) or "
+                "client= (serving path)"
+            )
+        step_fn = _jitted_eval_step(agent, greedy)
+        key = jax.random.key(seed)
     returns, lengths = [], []
     for ep in range(num_episodes):
         obs, _ = env.reset(seed=seed + ep)
-        state = agent.initial_state(1)
+        if client is None:
+            state = agent.initial_state(1)
         first = True
         ep_return, ep_len = 0.0, 0
         while True:
-            # Host numpy in, so placement follows params (no stray transfer
-            # onto the default device — see vector_actor.py on the cost).
-            key, action, state = step_fn(
-                params,
-                key,
-                np.asarray(obs)[None],
-                np.asarray([first]),
-                state,
-            )
-            obs, reward, terminated, truncated, _ = env.step(int(action[0]))
+            if client is not None:
+                action_int = int(client.act(np.asarray(obs), first))
+            else:
+                # Host numpy in, so placement follows params (no stray
+                # transfer onto the default device — see vector_actor.py
+                # on the cost).
+                key, action, state = step_fn(
+                    params,
+                    key,
+                    np.asarray(obs)[None],
+                    np.asarray([first]),
+                    state,
+                )
+                action_int = int(action[0])
+            obs, reward, terminated, truncated, _ = env.step(action_int)
             ep_return += float(reward)
             ep_len += 1
             first = False

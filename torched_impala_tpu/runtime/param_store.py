@@ -6,35 +6,93 @@ snapshot under a lock with a frame-count version stamp (the analog's
 `(num_frames, params)` tuple, `learner.py:83,203`); actors poll. The version
 stamp doubles as the staleness telemetry both for logging and for the
 semantic-race checks in tests.
+
+Beyond the latest-only cell, the store retains a keep-last-K ring of
+recent versions (`get_version`): the serving tier's `VersionRegistry`
+pins concrete versions for A/B + shadow routing (serving/registry.py),
+and IMPACT-style target networks (ROADMAP sample-reuse item) read a
+version pinned N publishes ago. Retention is bounded — publishing
+version K+1 evicts the oldest — so the ring can never grow host memory
+without bound.
 """
 
 from __future__ import annotations
 
+import collections
 import threading
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 
 class ParamStore:
-    """Thread-safe (version, params) cell with blocking first-publish."""
+    """Thread-safe (version, params) cell with blocking first-publish,
+    plus a bounded ring of recent versions for pinned reads.
 
-    def __init__(self) -> None:
+    SHARING CONTRACT: `get()` / `get_version()` return the SAME params
+    object that was published — a shared reference, not a copy. Callers
+    must treat it as immutable (the learner publishes `host_snapshot`
+    copies precisely so the published tree never mutates); anything that
+    needs a private mutable tree must copy it itself. Pinned in
+    tests/test_serving.py::TestParamStore.
+    """
+
+    def __init__(self, keep_versions: int = 4) -> None:
+        if keep_versions < 1:
+            raise ValueError(
+                f"keep_versions must be >= 1, got {keep_versions}"
+            )
         self._lock = threading.Lock()
         self._published = threading.Event()
         self._version = -1
         self._params: Any = None
+        self._keep = keep_versions
+        # version -> params, oldest first; bounded to `keep_versions`.
+        self._ring: "collections.OrderedDict[int, Any]" = (
+            collections.OrderedDict()
+        )
 
     def publish(self, version: int, params: Any) -> None:
         with self._lock:
             self._version = version
             self._params = params
+            self._ring[version] = params
+            self._ring.move_to_end(version)
+            while len(self._ring) > self._keep:
+                self._ring.popitem(last=False)
         self._published.set()
 
     def get(self, timeout: Optional[float] = None) -> tuple[int, Any]:
-        """Latest (version, params); blocks until the first publish."""
+        """Latest (version, params); blocks until the first publish.
+        Returns a shared reference to the published tree (see class
+        docstring) — do not mutate."""
         if not self._published.wait(timeout=timeout):
             raise TimeoutError("no params published yet")
         with self._lock:
             return self._version, self._params
+
+    def get_version(self, version: int) -> Any:
+        """Params pinned at `version` (shared reference, like `get`).
+
+        Raises KeyError when `version` was never published or has been
+        evicted from the keep-last-K ring — callers holding a pin must
+        either re-pin to a retained version or treat the policy as gone.
+        """
+        with self._lock:
+            try:
+                return self._ring[version]
+            except KeyError:
+                raise KeyError(
+                    f"version {version} not retained (have "
+                    f"{list(self._ring)}; keep_versions={self._keep})"
+                ) from None
+
+    def versions(self) -> List[int]:
+        """Retained versions, oldest publish first."""
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def keep_versions(self) -> int:
+        return self._keep
 
     @property
     def version(self) -> int:

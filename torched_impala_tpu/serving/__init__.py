@@ -1,0 +1,49 @@
+"""Serving tier: disaggregated batched policy inference (docs/SERVING.md).
+
+The Sebulba-shaped split (PAPERS.md, arxiv 2104.06272) as a standalone
+subsystem: a `PolicyServer` owns a device and continuous-batches action
+requests from many clients over a `VersionRegistry` of pinned policy
+versions (weighted A/B + shadow traffic) on top of the learner's
+versioned `ParamStore`. Transports: `InProcessClient` (same process)
+and the shm request ring (`serving/shm_ring.py`, cross-process).
+"""
+
+from torched_impala_tpu.serving.client import InProcessClient  # noqa: F401
+from torched_impala_tpu.serving.registry import (  # noqa: F401
+    VersionRegistry,
+)
+from torched_impala_tpu.serving.server import (  # noqa: F401
+    ClientDisconnected,
+    DeadlineExpired,
+    PolicyServer,
+    ServeResult,
+    ServerClosed,
+    ServingError,
+    cast_params,
+    greedy_action_parity,
+    mint_request_lid,
+)
+from torched_impala_tpu.serving.shm_ring import (  # noqa: F401
+    RingBackpressure,
+    ShmRingClient,
+    ShmRingPump,
+    ShmServingRing,
+)
+
+__all__ = [
+    "ClientDisconnected",
+    "DeadlineExpired",
+    "InProcessClient",
+    "PolicyServer",
+    "RingBackpressure",
+    "ServeResult",
+    "ServerClosed",
+    "ServingError",
+    "ShmRingClient",
+    "ShmRingPump",
+    "ShmServingRing",
+    "VersionRegistry",
+    "cast_params",
+    "greedy_action_parity",
+    "mint_request_lid",
+]

@@ -1,0 +1,203 @@
+"""VersionRegistry: named policy versions with weighted A/B + shadow routing.
+
+The version-management half of the serving tier (docs/SERVING.md): a
+`ParamStore` already retains a keep-last-K ring of published versions
+(runtime/param_store.py); this registry gives retained versions NAMES
+("stable", "canary", ...) and a routing policy over them, so a
+`PolicyServer` can answer one client from version A and its neighbor
+from version B while a third version scores every request in shadow.
+
+Semantics, pinned by tests/test_serving.py:
+
+- A LABEL is pinned to one concrete version; `pin(label)` with no
+  version pins the store's latest. Re-pinning a label is the deploy
+  primitive (counted as `serving/version_swaps`); the params a label
+  resolves to change only at `pin` time, never because the learner
+  published something newer.
+- ROUTING is sticky per client: `route(client_id)` hashes the client id
+  onto the weighted label set (blake2b — stable across processes and
+  runs, so a reconnecting client lands on the same arm). Sticky matters
+  for recurrent policies: a client's LSTM state should evolve under one
+  policy, not flap between arms per request.
+- SHADOW is a label whose actions are computed and logged but never
+  returned (`PolicyServer` runs it on a best-effort background thread);
+  `shadow_fraction` samples which primary waves get scored.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
+
+
+def _client_unit(client_id: int) -> float:
+    """Deterministic uniform-[0,1) hash of a client id (blake2b, stable
+    across processes/runs — NOT Python's salted `hash`)."""
+    digest = hashlib.blake2b(
+        str(int(client_id)).encode("ascii"), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big") / 2.0**64
+
+
+class VersionRegistry:
+    """Named, pinned policy versions over a ParamStore + weighted routing."""
+
+    def __init__(
+        self,
+        store: ParamStore,
+        telemetry: Optional[Registry] = None,
+    ) -> None:
+        self._store = store
+        self._lock = threading.Lock()
+        self._labels: Dict[str, int] = {}
+        # Cumulative routing table: [(cum_weight_upper, label)], weights
+        # normalized to sum 1. Empty until set_routing.
+        self._routing: List[Tuple[float, str]] = []
+        self._shadow: Optional[str] = None
+        self._shadow_fraction = 1.0
+        reg = telemetry if telemetry is not None else get_registry()
+        self._m_swaps = reg.counter("serving/version_swaps")
+
+    @classmethod
+    def serving_latest(
+        cls,
+        store: ParamStore,
+        label: str = "live",
+        telemetry: Optional[Registry] = None,
+        timeout: Optional[float] = None,
+    ) -> "VersionRegistry":
+        """The one-version convenience shape: pin `label` to the store's
+        latest publish and route 100% of clients to it."""
+        registry = cls(store, telemetry=telemetry)
+        registry.pin(label, timeout=timeout)
+        registry.set_routing({label: 1.0})
+        return registry
+
+    # -- pinning -----------------------------------------------------------
+
+    def pin(
+        self,
+        label: str,
+        version: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> int:
+        """Pin `label` to `version` (default: the store's latest; blocks
+        until the first publish). Raises KeyError when the version is not
+        retained by the store's keep-last-K ring. Returns the pinned
+        version."""
+        if version is None:
+            version = self._store.get(timeout=timeout)[0]
+        self._store.get_version(version)  # validate retained
+        with self._lock:
+            prev = self._labels.get(label)
+            self._labels[label] = int(version)
+        if prev is not None and prev != version:
+            self._m_swaps.inc()
+        return int(version)
+
+    def unpin(self, label: str) -> None:
+        with self._lock:
+            if label in {lbl for _, lbl in self._routing} or (
+                label == self._shadow
+            ):
+                raise ValueError(
+                    f"label {label!r} is still routed; update routing "
+                    "before unpinning"
+                )
+            self._labels.pop(label, None)
+
+    def pinned(self) -> Dict[str, int]:
+        """label -> pinned version snapshot."""
+        with self._lock:
+            return dict(self._labels)
+
+    # -- routing -----------------------------------------------------------
+
+    def set_routing(
+        self,
+        weights: Mapping[str, float],
+        shadow: Optional[str] = None,
+        shadow_fraction: float = 1.0,
+    ) -> None:
+        """Install a weighted A/B routing over pinned labels.
+
+        `weights` maps label -> positive weight (normalized internally).
+        `shadow` names a pinned label scored out-of-band on a sampled
+        `shadow_fraction` of primary waves; its actions are never
+        returned to clients."""
+        if not weights:
+            raise ValueError("routing needs at least one label")
+        if not 0.0 < shadow_fraction <= 1.0:
+            raise ValueError(
+                f"shadow_fraction must be in (0, 1], got {shadow_fraction}"
+            )
+        with self._lock:
+            unknown = [
+                lbl
+                for lbl in (*weights, *([shadow] if shadow else ()))
+                if lbl not in self._labels
+            ]
+            if unknown:
+                raise ValueError(
+                    f"routing names unpinned labels {unknown}; "
+                    f"pinned: {sorted(self._labels)}"
+                )
+            total = 0.0
+            for lbl, w in weights.items():
+                if w <= 0:
+                    raise ValueError(
+                        f"weight for {lbl!r} must be > 0, got {w}"
+                    )
+                total += float(w)
+            routing: List[Tuple[float, str]] = []
+            cum = 0.0
+            for lbl, w in sorted(weights.items()):
+                cum += float(w) / total
+                routing.append((cum, lbl))
+            routing[-1] = (1.0, routing[-1][1])  # close fp drift
+            self._routing = routing
+            self._shadow = shadow
+            self._shadow_fraction = float(shadow_fraction)
+
+    def route(self, client_id: int) -> str:
+        """The label serving `client_id` — deterministic and sticky (see
+        module docstring)."""
+        with self._lock:
+            routing = self._routing
+        if not routing:
+            raise RuntimeError(
+                "no routing configured; call set_routing (or build via "
+                "VersionRegistry.serving_latest)"
+            )
+        u = _client_unit(client_id)
+        for cum, label in routing:
+            if u < cum:
+                return label
+        return routing[-1][1]
+
+    def resolve(self, label: str) -> Tuple[int, Any]:
+        """(version, params) pinned at `label` — ONE consistent snapshot
+        (the wave-consistency primitive: a server resolves once per wave,
+        so a concurrent re-pin affects the next wave, never rows within
+        one)."""
+        with self._lock:
+            try:
+                version = self._labels[label]
+            except KeyError:
+                raise KeyError(
+                    f"label {label!r} not pinned (have "
+                    f"{sorted(self._labels)})"
+                ) from None
+        return version, self._store.get_version(version)
+
+    @property
+    def shadow(self) -> Optional[str]:
+        return self._shadow
+
+    @property
+    def shadow_fraction(self) -> float:
+        return self._shadow_fraction

@@ -1,0 +1,328 @@
+"""Shm request/response ring: the cross-process serving transport.
+
+Reuses the `ProcessEnvPool` lane pattern (runtime/env_pool.py): ONE
+SharedMemory segment holding typed numpy lanes, aligned with the same
+`align()` helper, written in place with zero per-request pickling:
+
+  [ status lane [R] uint8   ]  slot lifecycle (see below)
+  [ first  lane [R] bool    ]  client-written episode-boundary flags
+  [ action lane [R] int32   ]  server-written actions
+  [ version lane [R] int64  ]  server-written policy version per action
+  [ obs block  [R, *obs]    ]  client-written observations
+
+Each ring is one client connection (SPSC: one writer on each side), and
+a ring slot walks FREE -> REQUEST -> RESPONSE|ERROR -> FREE:
+
+  client: wait status==FREE (BACKPRESSURE: a full ring blocks submit
+          until the server frees a slot — the wraparound test), write
+          obs+first, then status=REQUEST last (the publish edge);
+  pump:   scan REQUEST slots in order, forward to `PolicyServer.submit`
+          (the server's one-request-per-client-per-wave rule keeps a
+          pipelining client's recurrent-state chain causal), write
+          action+version back, then status=RESPONSE;
+  client: read its oldest outstanding slot, then status=FREE.
+
+Same-host only by design (like the env pool's lanes): the status byte is
+the happens-before edge under the platform's total-store-order; there is
+no cross-host story here. A client in another process attaches via the
+picklable `descriptor()` — it needs numpy and this module, never jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torched_impala_tpu.runtime.env_pool import align
+
+STATUS_FREE = 0
+STATUS_REQUEST = 1
+STATUS_RESPONSE = 2
+STATUS_ERROR = 3
+
+
+class RingBackpressure(TimeoutError):
+    """submit() found no FREE slot within its timeout (ring full)."""
+
+
+class ShmServingRing:
+    """The shared segment + typed lane views (constructable from either
+    side; the CREATING side unlinks at close)."""
+
+    def __init__(
+        self,
+        *,
+        capacity: int,
+        obs_shape: Sequence[int],
+        obs_dtype,
+        shm_name: Optional[str] = None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.obs_shape = tuple(obs_shape)
+        self.obs_dtype = np.dtype(obs_dtype)
+        R = capacity
+        self._status_off = 0
+        self._first_off = align(R)
+        self._action_off = align(self._first_off + R)
+        self._version_off = align(self._action_off + 4 * R)
+        self._obs_off = align(self._version_off + 8 * R)
+        obs_bytes = R * int(np.prod(self.obs_shape)) * self.obs_dtype.itemsize
+        size = max(1, self._obs_off + obs_bytes)
+        self._owner = shm_name is None
+        if self._owner:
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+        else:
+            self._shm = shared_memory.SharedMemory(name=shm_name)
+        buf = self._shm.buf
+        self.status = np.ndarray(
+            (R,), np.uint8, buffer=buf[self._status_off : self._status_off + R]
+        )
+        self.first = np.ndarray(
+            (R,), np.bool_, buffer=buf[self._first_off : self._first_off + R]
+        )
+        self.action = np.ndarray(
+            (R,), np.int32,
+            buffer=buf[self._action_off : self._action_off + 4 * R],
+        )
+        self.version = np.ndarray(
+            (R,), np.int64,
+            buffer=buf[self._version_off : self._version_off + 8 * R],
+        )
+        self.obs = np.ndarray(
+            (R, *self.obs_shape), self.obs_dtype,
+            buffer=buf[self._obs_off : self._obs_off + obs_bytes],
+        )
+        if self._owner:
+            self.status[:] = STATUS_FREE
+        self._closed = False
+
+    def descriptor(self) -> dict:
+        """Picklable attach info for a client in another process."""
+        return {
+            "shm_name": self._shm.name,
+            "capacity": self.capacity,
+            "obs_shape": self.obs_shape,
+            "obs_dtype": self.obs_dtype.str,
+        }
+
+    @classmethod
+    def attach(cls, descriptor: dict) -> "ShmServingRing":
+        return cls(
+            capacity=descriptor["capacity"],
+            obs_shape=descriptor["obs_shape"],
+            obs_dtype=np.dtype(descriptor["obs_dtype"]),
+            shm_name=descriptor["shm_name"],
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # Lane views must drop before close() (see ProcessEnvPool.close).
+        del self.status, self.first, self.action, self.version, self.obs
+        self._shm.close()
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmRingClient:
+    """Client half: submit/result over an (attached) ring, FIFO, with up
+    to `capacity` requests pipelined before backpressure blocks."""
+
+    def __init__(
+        self, ring: ShmServingRing, poll_s: float = 5e-5
+    ) -> None:
+        self._ring = ring
+        self._poll_s = poll_s
+        self._head = 0  # next slot to write
+        self._tail = 0  # next slot to read
+        self.full_waits = 0  # backpressure events observed (telemetry-free
+        # client side: a cross-process client has no registry to record to)
+
+    @property
+    def outstanding(self) -> int:
+        return self._head - self._tail
+
+    def submit(
+        self, obs, first: bool, timeout_s: Optional[float] = 5.0
+    ) -> int:
+        """Write one request; blocks while the ring is full (all
+        `capacity` slots hold unanswered/unread traffic). Returns the
+        request's sequence number."""
+        ring = self._ring
+        i = self._head % ring.capacity
+        deadline = None if timeout_s is None else (
+            time.monotonic() + timeout_s
+        )
+        waited = False
+        while ring.status[i] != STATUS_FREE:
+            if not waited:
+                waited = True
+                self.full_waits += 1  # counted even if we then time out
+            if deadline is not None and time.monotonic() > deadline:
+                raise RingBackpressure(
+                    f"ring full: slot {i} still "
+                    f"{int(ring.status[i])} after {timeout_s}s"
+                )
+            time.sleep(self._poll_s)
+        ring.obs[i] = np.asarray(obs)
+        ring.first[i] = bool(first)
+        ring.status[i] = STATUS_REQUEST  # publish edge: written LAST
+        seq = self._head
+        self._head += 1
+        return seq
+
+    def result(
+        self, timeout_s: Optional[float] = 30.0
+    ) -> Tuple[int, int]:
+        """Blocking read of the OLDEST outstanding request's response:
+        (action, version). Raises RuntimeError on a server-side ERROR
+        slot."""
+        if self.outstanding == 0:
+            raise RuntimeError("no outstanding requests")
+        ring = self._ring
+        i = self._tail % ring.capacity
+        deadline = None if timeout_s is None else (
+            time.monotonic() + timeout_s
+        )
+        while ring.status[i] not in (STATUS_RESPONSE, STATUS_ERROR):
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no response in slot {i} within {timeout_s}s"
+                )
+            time.sleep(self._poll_s)
+        status = int(ring.status[i])
+        action = int(ring.action[i])
+        version = int(ring.version[i])
+        ring.status[i] = STATUS_FREE  # hand the slot back
+        self._tail += 1
+        if status == STATUS_ERROR:
+            raise RuntimeError(
+                f"server failed request (ring slot {i})"
+            )
+        return action, version
+
+    def act(
+        self, obs, first: bool, timeout_s: Optional[float] = 30.0
+    ) -> int:
+        """Synchronous request (no pipelining): submit + wait."""
+        self.submit(obs, first, timeout_s=timeout_s)
+        return self.result(timeout_s=timeout_s)[0]
+
+
+class ShmRingPump:
+    """Server half: one thread forwarding REQUEST slots of every attached
+    ring into `PolicyServer.submit` and writing responses back in place.
+
+    Polling, not blocking: the pump is the bridge between the lock-free
+    shm side and the condition-variable server side, and a ~50us poll is
+    far below any wave latency. Each ring maps to one server client slot
+    (sticky routing, per-client recurrent state — exactly like an
+    in-process client)."""
+
+    def __init__(self, server, poll_s: float = 5e-5) -> None:
+        self._server = server
+        self._poll_s = poll_s
+        self._lock = threading.Lock()
+        # ring -> [server slot, next absolute index, in-flight slot set]
+        self._rings: Dict[ShmServingRing, list] = {}
+        # (ring, ring slot index, result cell) in flight
+        self._in_flight: List[Tuple[ShmServingRing, int, object]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def attach(self, ring: ShmServingRing, greedy: bool = True) -> int:
+        """Register a ring; returns the server client slot serving it."""
+        slot = self._server.connect(greedy=greedy)
+        with self._lock:
+            self._rings[ring] = [slot, 0, set()]
+        return slot
+
+    def start(self) -> "ShmRingPump":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serving-ring-pump", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._lock:
+            for slot, _next, _flight in self._rings.values():
+                try:
+                    self._server.disconnect(slot)
+                except Exception:
+                    pass
+            self._rings.clear()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            busy = self._pump_once()
+            if not busy:
+                time.sleep(self._poll_s)
+
+    def _pump_once(self) -> bool:
+        """One scan: submit new REQUEST slots, write back finished cells.
+        Returns True when any work happened."""
+        busy = False
+        with self._lock:
+            rings = list(self._rings.items())
+        for ring, entry in rings:
+            slot, next_i, flight = entry
+            # Pick up requests IN ORDER; stop at the first non-REQUEST
+            # slot so responses stay FIFO per ring. A REQUEST slot that
+            # is already in flight is the WRAPAROUND case (next_i lapped
+            # the ring while the server still owes its answer) — never
+            # re-submit it.
+            while True:
+                i = next_i % ring.capacity
+                if (
+                    ring.status[i] != STATUS_REQUEST
+                    or i in flight
+                ):
+                    break
+                obs = np.array(ring.obs[i])  # copy out before freeing
+                first = bool(ring.first[i])
+                cell = self._server.submit(slot, obs, first)
+                self._in_flight.append((ring, i, cell))
+                flight.add(i)
+                entry[1] = next_i = next_i + 1
+                busy = True
+        still: List[Tuple[ShmServingRing, int, object]] = []
+        for ring, i, cell in self._in_flight:
+            if not cell.done():
+                still.append((ring, i, cell))
+                continue
+            busy = True
+            try:
+                result = cell.result(timeout=0)
+                ring.action[i] = result.action
+                ring.version[i] = result.version
+                ring.status[i] = STATUS_RESPONSE
+            except Exception:
+                ring.action[i] = -1
+                ring.version[i] = -1
+                ring.status[i] = STATUS_ERROR
+            entry = self._rings.get(ring)
+            if entry is not None:
+                entry[2].discard(i)
+        self._in_flight = still
+        return busy
