@@ -1,0 +1,48 @@
+"""Seeded jit-boundary violations (impala-lint fixture — parsed, never
+imported). One positive per rule; tests/test_lint.py asserts each."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky_step(x):
+    y = x * 2
+    print("tracing", y)  # <- fires at trace time only
+    z = np.asarray(y)  # <- host materialization inside jit
+    return float(x.sum()) + z.mean()  # <- float() on a traced value
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def sync_inside(x, n):
+    jax.block_until_ready(x)  # <- host blocks inside jit
+    return x.sum().item() + n  # <- .item() device->host
+
+
+class Trainer:
+    """jit root discovered through jax.jit(self._impl, ...) plus the
+    self-call closure, and a donated arg read after the call."""
+
+    def __init__(self):
+        self._step = jax.jit(self._impl, donate_argnums=(0,))
+
+    def _impl(self, params, batch):
+        return self._loss(params, batch)
+
+    def _loss(self, params, batch):
+        del batch
+        return jax.device_get(params)  # <- host sync in traced helper
+
+    def train(self, params, batch):
+        new_params = self._step(params, batch)  # donates params...
+        stale = jnp.sum(params)  # <- ...then reads the donated buffer
+        return new_params, stale
+
+    def consume(self, data):  # lint: hot-loop
+        total = 0.0
+        for row in data:
+            total += row.sum().item()  # <- sync inside a hot loop
+        return total

@@ -1,0 +1,34 @@
+"""Clean jit-boundary patterns (impala-lint fixture — parsed, never
+imported): the negative case per rule. Must produce ZERO findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(x):
+    # jnp stays on device; float() of a closure CONSTANT is static.
+    scale = float(np.prod((2, 2)))
+    jax.debug.print("x sum {s}", s=x.sum())  # the in-jit print
+    return jnp.tanh(x) * scale
+
+
+class Trainer:
+    def __init__(self):
+        self._step = jax.jit(self._impl, donate_argnums=(0,))
+
+    def _impl(self, params, batch):
+        return jax.tree.map(lambda p: p + batch.mean(), params)
+
+    def train(self, params, batch):
+        # Donated arg rebound from the result: dead afterwards, correct.
+        params = self._step(params, batch)
+        return params
+
+    def consume(self, data):  # lint: hot-loop
+        total = jnp.zeros(())
+        for row in data:
+            total = total + row.sum()  # stays on device
+        # Deliberate sync, annotated where it happens:
+        return total.item()  # lint: allow(jit-boundary)

@@ -1,0 +1,15 @@
+"""Seeded telemetry-grammar violations (impala-lint fixture — parsed,
+never imported). One positive per rule; tests/test_lint.py asserts
+each. Mirrors the legacy check_metric_names fixture cases exactly."""
+
+reg.counter("NoSlash")  # name-grammar  # noqa: F821
+reg.gauge("pool/depth")  # noqa: F821
+reg.timer("pool/depth")  # type-fork with the gauge above  # noqa: F821
+x = "telemetry/bad key here"  # prose: must NOT flag
+y = "telemetry/bad/Key"  # malformed literal: not flagged (charset)
+z = "telemetry/ok/key"
+bad_literal = "telemetry/0bad"  # literal-key (leading digit component)
+reg.counter("resilience/orphan_series")  # subfamily-prefix  # noqa: F821
+reg.counter("serving/orphan_series")  # subfamily-prefix  # noqa: F821
+rec.instant("Bad.Trace")  # trace-grammar  # noqa: F821
+rec.complete("serving/rogue_event", 0, 1)  # trace-closed-set  # noqa: F821

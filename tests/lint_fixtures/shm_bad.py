@@ -1,0 +1,33 @@
+"""Seeded shm-lifecycle violations (impala-lint fixture — parsed, never
+imported). One positive per rule; tests/test_lint.py asserts each."""
+
+import numpy as np
+from multiprocessing import shared_memory
+
+
+class LeakyOwner:
+    """no-close AND no-unlink: owns a segment, tears nothing down."""
+
+    def __init__(self, size: int):
+        self._shm = shared_memory.SharedMemory(create=True, size=size)
+        self.lane = np.ndarray((size,), np.uint8, buffer=self._shm.buf)
+
+
+class CloseButNoUnlink:
+    """no-unlink: closes its mapping but leaves the name in /dev/shm."""
+
+    def __init__(self, size: int):
+        self._shm = shared_memory.SharedMemory(create=True, size=size)
+
+    def close(self):
+        self._shm.close()
+
+
+def attach_and_maybe_leak(name: str):
+    """local-no-finally: an exception between attach and close leaks
+    the mapping."""
+    shm = shared_memory.SharedMemory(name=name)
+    view = np.ndarray((8,), np.uint8, buffer=shm.buf)
+    total = int(view.sum())  # may raise on a truncated segment
+    shm.close()
+    return total

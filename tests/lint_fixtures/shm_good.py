@@ -1,0 +1,51 @@
+"""Clean shm-lifecycle patterns (impala-lint fixture — parsed, never
+imported): the negative case per rule. Must produce ZERO findings."""
+
+import numpy as np
+from multiprocessing import shared_memory
+
+
+class TidyOwner:
+    """Owner: close + unlink on teardown, __del__ safety net."""
+
+    def __init__(self, size: int):
+        self._shm = shared_memory.SharedMemory(create=True, size=size)
+        self.lane = np.ndarray((size,), np.uint8, buffer=self._shm.buf)
+        self._closed = False
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        del self.lane
+        self._shm.close()
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TidyAttacher:
+    """Attach side: close only — the owner unlinks."""
+
+    def __init__(self, name: str):
+        self._shm = shared_memory.SharedMemory(name=name)
+
+    def close(self):
+        self._shm.close()
+
+
+def attach_and_sum(name: str):
+    """Local attach closed in a finally: every exit path unmaps."""
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        view = np.ndarray((8,), np.uint8, buffer=shm.buf)
+        return int(view.sum())
+    finally:
+        shm.close()

@@ -1,0 +1,105 @@
+"""Seeded thread-safety violations (impala-lint fixture — parsed, never
+imported). One positive per rule; tests/test_lint.py asserts each."""
+
+import threading
+
+
+class UnguardedCounter:
+    """unguarded-attr: background thread writes `count`, foreground
+    reads it, no lock held anywhere."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.count += 1  # <- unguarded cross-thread write
+
+    def read(self):
+        return self.count
+
+
+class MixedLocks:
+    """mixed-locks: `state` written under lock_a in one method and
+    lock_b in another — two locks exclude nobody."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.state = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock_a:
+            self.state += 1
+
+    def poke(self):
+        with self._lock_b:
+            self.state = 0
+
+
+class BadAnnotation:
+    """unknown-lock: guarded-by names a lock the class never declares."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.flag = False  # lint: guarded-by(_missing_lock)
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.flag = True
+
+    def read(self):
+        return self.flag
+
+
+class LockCycle:
+    """lock-cycle: a() takes lock1 then lock2, b() takes lock2 then
+    lock1 — the classic ABBA deadlock schedule."""
+
+    def __init__(self):
+        self._lock1 = threading.Lock()
+        self._lock2 = threading.Lock()
+
+    def a(self):
+        with self._lock1:
+            with self._lock2:
+                pass
+
+    def b(self):
+        with self._lock2:
+            with self._lock1:
+                pass
+
+
+class IndirectCycle:
+    """lock-cycle through a call: outer() holds lock_x and calls
+    helper(), which takes lock_y; rev() nests them the other way."""
+
+    def __init__(self):
+        self._lock_x = threading.Lock()
+        self._lock_y = threading.Lock()
+
+    def outer(self):
+        with self._lock_x:
+            self.helper()
+
+    def helper(self):
+        with self._lock_y:
+            pass
+
+    def rev(self):
+        with self._lock_y:
+            with self._lock_x:
+                pass

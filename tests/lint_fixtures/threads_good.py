@@ -1,0 +1,64 @@
+"""Clean thread-safety patterns (impala-lint fixture — parsed, never
+imported): every rule's negative case. Must produce ZERO findings."""
+
+import collections
+import queue
+import threading
+
+
+class GuardedCounter:
+    """Writes under one declared lock from both thread groups."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+
+class AnnotatedHelpers:
+    """Caller-holds-lock methods (guarded-by on the def), a declared
+    gil-atomic flag, thread-safe containers bound once in __init__, and
+    correctly ORDERED nested locks (one direction only — no cycle)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._q = queue.Queue()
+        self._pending = collections.deque()
+        self._stop = threading.Event()
+        # Single-writer atomic rebind: background sets, foreground reads.
+        self.error = None  # lint: guarded-by(gil)
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    self._mutate_locked()
+                    with self._aux:
+                        pass
+        except BaseException as e:
+            self.error = e
+
+    def _mutate_locked(self):  # lint: guarded-by(_lock)
+        self.value = 1
+
+    def submit(self, item):
+        self._q.put(item)
+        with self._lock:
+            self._mutate_locked()

@@ -1,0 +1,498 @@
+"""impala-lint suite tests (ISSUE 7): framework, the four checkers
+against seeded fixtures, the full-tree tier-1 gate, the lock-graph
+coverage acceptance, the check_metric_names shim, the thread
+excepthook, and the shm cleanup-under-kill regression.
+
+Fixture files live under tests/lint_fixtures/ — they are PARSED by the
+checkers, never imported, so each can seed violations freely. Every
+rule has one positive (the *_bad fixture makes it fire) and one
+negative (the *_good fixture stays silent).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import (  # noqa: E402
+    apply_baseline,
+    load_baseline,
+    load_files,
+    parse_directives,
+    run_all,
+)
+from tools.lint.core import (  # noqa: E402
+    SourceFile,
+    apply_inline_allows,
+    framework_findings,
+)
+from tools.lint import jitb, metrics, shm, threads  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def fixture(name: str) -> SourceFile:
+    path = os.path.join(FIXTURES, name)
+    with open(path, encoding="utf-8") as f:
+        return SourceFile(path, name, f.read())
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---- framework ----------------------------------------------------------
+
+
+class TestFramework:
+    def test_parse_directives(self):
+        ds = parse_directives(
+            "x = 1  # lint: allow(thread-safety), guarded-by(_lock)"
+        )
+        assert [(d.name, d.arg) for d in ds] == [
+            ("allow", "thread-safety"),
+            ("guarded-by", "_lock"),
+        ]
+        assert parse_directives("x = 1  # plain comment") == []
+
+    def test_malformed_directive_is_a_finding(self):
+        sf = SourceFile("<m>", "m.py", "x = 1  # lint: guard-by(_lock)\n")
+        fs = framework_findings([sf])
+        assert [f.rule for f in fs] == ["framework/bad-annotation"]
+        assert "guard-by(_lock)" in fs[0].message
+
+    def test_parse_error_is_a_finding(self):
+        sf = SourceFile("<p>", "p.py", "def broken(:\n")
+        fs = framework_findings([sf])
+        assert [f.rule for f in fs] == ["framework/parse-error"]
+
+    def test_allow_suppresses_only_matching_rule(self):
+        sf = fixture("jit_bad.py")
+        found = jitb.check([sf])
+        assert found, "fixture must produce findings"
+        # allow(all) on every line would drop them; a non-matching allow
+        # must not.
+        kept = apply_inline_allows([sf], found)
+        assert kept == found
+
+    def test_baseline_suppression_and_staleness(self, tmp_path):
+        sf = fixture("shm_bad.py")
+        found = shm.check([sf])
+        assert found
+        f0 = found[0]
+        bl = tmp_path / "baseline.txt"
+        bl.write_text(
+            f"{f0.rule} {f0.baseline_key} grandfathered: fixture\n"
+            "shm-lifecycle/no-close gone.py::Gone._shm stale entry\n"
+        )
+        entries = load_baseline(str(bl))
+        result = apply_baseline(found, entries)
+        assert f0 not in result.findings
+        assert len(result.suppressed) >= 1
+        assert [e.key for e in result.stale_baseline] == [
+            "gone.py::Gone._shm"
+        ]
+
+    def test_baseline_requires_justification(self, tmp_path):
+        bl = tmp_path / "baseline.txt"
+        bl.write_text("shm-lifecycle/no-close some.py::C._shm\n")
+        with pytest.raises(ValueError, match="justification"):
+            load_baseline(str(bl))
+
+
+# ---- thread-safety checker ----------------------------------------------
+
+
+class TestThreadChecker:
+    def test_bad_fixture_fires_every_rule(self):
+        found = threads.check([fixture("threads_bad.py")])
+        rules = rules_of(found)
+        assert "thread-safety/unguarded-attr" in rules
+        assert "thread-safety/mixed-locks" in rules
+        assert "thread-safety/unknown-lock" in rules
+        assert "thread-safety/lock-cycle" in rules
+        keys = {f.baseline_key for f in found}
+        assert "threads_bad.py::UnguardedCounter.count" in keys
+        assert "threads_bad.py::MixedLocks.state" in keys
+
+    def test_lock_cycle_direct_and_through_call(self):
+        found = [
+            f
+            for f in threads.check([fixture("threads_bad.py")])
+            if f.rule == "thread-safety/lock-cycle"
+        ]
+        cycles = " | ".join(f.message for f in found)
+        assert "LockCycle._lock1" in cycles
+        assert "IndirectCycle._lock_x" in cycles  # via helper() call
+
+    def test_good_fixture_is_clean(self):
+        sf = fixture("threads_good.py")
+        assert apply_inline_allows([sf], threads.check([sf])) == []
+
+    def test_lock_graph_covers_required_subsystems(self):
+        """Acceptance: the lock-order graph must span the learner,
+        serving, resilience, and traj_ring locks."""
+        nodes, _edges = threads.build_lock_graph(load_files(REPO))
+        required = {
+            "Learner._auto_lock",  # learner
+            "PolicyServer._service_lock",  # serving
+            "PolicyServer._cond",
+            "ShmRingPump._lock",
+            "AsyncCheckpointer._pending_lock",  # resilience
+            "TrajectoryRing._cond",  # traj_ring
+            "ActorSupervisor._lock",
+        }
+        assert required <= nodes, f"missing: {required - nodes}"
+
+
+# ---- jit-boundary checker -----------------------------------------------
+
+
+class TestJitChecker:
+    def test_bad_fixture_fires_every_rule(self):
+        found = jitb.check([fixture("jit_bad.py")])
+        rules = rules_of(found)
+        assert "jit-boundary/host-sync-in-jit" in rules
+        assert "jit-boundary/host-sync-in-hot-loop" in rules
+        assert "jit-boundary/donated-arg-alive" in rules
+        msgs = " | ".join(f.message for f in found)
+        assert ".item()" in msgs
+        assert "print" in msgs
+        assert "asarray" in msgs
+        assert "float()" in msgs
+        assert "device_get" in msgs  # traced through the self-call chain
+
+    def test_donated_arg_site_names_symbol(self):
+        found = [
+            f
+            for f in jitb.check([fixture("jit_bad.py")])
+            if f.rule == "jit-boundary/donated-arg-alive"
+        ]
+        assert len(found) == 1
+        assert "params" in found[0].message
+
+    def test_good_fixture_is_clean(self):
+        sf = fixture("jit_good.py")
+        assert apply_inline_allows([sf], jitb.check([sf])) == []
+
+
+# ---- shm-lifecycle checker ----------------------------------------------
+
+
+class TestShmChecker:
+    def test_bad_fixture_fires_every_rule(self):
+        found = shm.check([fixture("shm_bad.py")])
+        by_rule = {}
+        for f in found:
+            by_rule.setdefault(f.rule, []).append(f.baseline_key)
+        assert "shm_bad.py::LeakyOwner._shm" in by_rule[
+            "shm-lifecycle/no-close"
+        ]
+        assert set(by_rule["shm-lifecycle/no-unlink"]) == {
+            "shm_bad.py::LeakyOwner._shm",
+            "shm_bad.py::CloseButNoUnlink._shm",
+        }
+        assert by_rule["shm-lifecycle/local-no-finally"] == [
+            "shm_bad.py::attach_and_maybe_leak.shm"
+        ]
+
+    def test_good_fixture_is_clean(self):
+        sf = fixture("shm_good.py")
+        assert apply_inline_allows([sf], shm.check([sf])) == []
+
+
+# ---- telemetry checker + shim -------------------------------------------
+
+
+class TestMetricsChecker:
+    def test_bad_fixture_fires_every_rule(self):
+        found = metrics.check([fixture("metrics_bad.py")])
+        rules = rules_of(found)
+        assert rules == {
+            "telemetry/name-grammar",
+            "telemetry/type-fork",
+            "telemetry/literal-key",
+            "telemetry/subfamily-prefix",
+            "telemetry/trace-grammar",
+            "telemetry/trace-closed-set",
+        }
+        msgs = " | ".join(f.message for f in found)
+        assert "NoSlash" in msgs
+        assert "registered it as gauge" in msgs
+        assert "Bad.Trace" in msgs
+        # prose string and malformed-charset literal must NOT flag
+        assert "bad key here" not in msgs and "bad/Key" not in msgs
+
+    def test_good_fixture_is_clean(self):
+        sf = fixture("metrics_good.py")
+        assert metrics.check([sf]) == []
+
+    def test_shim_check_matches_framework(self, tmp_path):
+        """tools/check_metric_names.py stays a faithful shim: same
+        findings, legacy string format."""
+        import importlib.util
+
+        pkg = tmp_path / "torched_impala_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            'reg.counter("NoSlash")\n'
+            'reg.gauge("pool/depth")\n'
+            'reg.timer("pool/depth")\n'
+        )
+        spec = importlib.util.spec_from_file_location(
+            "check_metric_names_shim",
+            os.path.join(REPO, "tools", "check_metric_names.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        errors = mod.check(str(tmp_path))
+        assert len(errors) == 2
+        assert errors[0].startswith("torched_impala_tpu/bad.py:1: ")
+        assert "NoSlash" in errors[0]
+        assert "registered it as gauge" in errors[1]
+
+
+# ---- full tree: the tier-1 gate -----------------------------------------
+
+
+class TestFullTree:
+    def test_tree_lints_clean_with_baseline(self):
+        """Acceptance: `python -m tools.lint` exits 0 on the tree —
+        zero non-baselined findings across all four checkers."""
+        result = run_all(REPO)
+        assert result.findings == [], "\n".join(
+            f.format() for f in result.findings
+        )
+        # The baseline must carry no stale entries (a fixed finding
+        # leaves a suppression behind) and real justifications.
+        assert result.stale_baseline == [], [
+            e.key for e in result.stale_baseline
+        ]
+        for _f, entry in result.suppressed:
+            assert len(entry.justification) >= 10, entry
+
+    def test_thread_safety_reports_real_finding_without_baseline(self):
+        """Acceptance: the thread-safety checker surfaces >= 1 genuine
+        pre-existing finding on this tree — suppressed only by the
+        justified baseline (the Learner train-state trio)."""
+        result = run_all(REPO, baseline_path=None)
+        ts = [
+            f
+            for f in result.findings
+            if f.rule.startswith("thread-safety/")
+        ]
+        assert len(ts) >= 1
+        keys = {f.baseline_key for f in ts}
+        assert (
+            "torched_impala_tpu/runtime/learner.py::Learner._params"
+            in keys
+        )
+
+    def test_cli_exit_codes(self, tmp_path):
+        env = dict(os.environ, PYTHONPATH=REPO)
+        clean = subprocess.run(
+            [sys.executable, "-m", "tools.lint"],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+        )
+        assert clean.returncode == 0, clean.stderr
+        assert "impala-lint: OK" in clean.stderr
+        # A seeded violation flips the exit code.
+        pkg = tmp_path / "torched_impala_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text('reg.counter("NoSlash")\n')
+        dirty = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.lint",
+                "--root",
+                str(tmp_path),
+                "--baseline",
+                "none",
+            ],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+        )
+        assert dirty.returncode == 1, dirty.stderr
+        assert "NoSlash" in dirty.stderr
+
+    def test_doctor_lint_selfcheck_passes(self):
+        from torched_impala_tpu.doctor import _check_lint
+
+        status, detail = _check_lint()
+        assert status == "ok", detail
+
+
+# ---- satellite: thread excepthook ---------------------------------------
+
+
+class TestThreadExcepthook:
+    def test_crash_reaches_telemetry_and_stderr(self, capfd):
+        from torched_impala_tpu.telemetry import (
+            Registry,
+            install_thread_excepthook,
+            uninstall_thread_excepthook,
+        )
+        import torched_impala_tpu.telemetry.excepthook as eh
+
+        fresh = Registry()
+        orig_get = eh.get_registry
+        try:
+            install_thread_excepthook()
+            # Route the hook's registry lookup at a fresh registry.
+            eh.get_registry = lambda: fresh
+            t = threading.Thread(
+                target=lambda: (_ for _ in ()).throw(
+                    RuntimeError("boom-in-thread")
+                ),
+                name="doomed",
+            )
+            t.start()
+            t.join(timeout=5)
+        finally:
+            eh.get_registry = orig_get
+            uninstall_thread_excepthook()
+        snap = fresh.snapshot()
+        assert snap.get("telemetry/runtime/thread_crashes") == 1, snap
+        err = capfd.readouterr().err
+        assert "doomed" in err and "RuntimeError" in err
+        assert "thread_crashes" in err
+
+    def test_install_is_idempotent_and_uninstall_restores(self):
+        from torched_impala_tpu.telemetry import (
+            install_thread_excepthook,
+            uninstall_thread_excepthook,
+        )
+        import torched_impala_tpu.telemetry.excepthook as eh
+
+        before = threading.excepthook
+        install_thread_excepthook()
+        hooked = threading.excepthook
+        install_thread_excepthook()  # second install: no rewrap
+        assert threading.excepthook is hooked
+        assert eh.installed()
+        uninstall_thread_excepthook()
+        assert threading.excepthook is before
+        assert not eh.installed()
+
+    def test_loop_train_installs_hook(self):
+        """loop.train arms the hook (satellite wiring)."""
+        import inspect
+
+        from torched_impala_tpu.runtime import loop
+
+        src = inspect.getsource(loop.train)
+        assert "install_thread_excepthook()" in src
+
+    def test_server_start_installs_hook(self):
+        import inspect
+
+        from torched_impala_tpu.serving.server import PolicyServer
+
+        src = inspect.getsource(PolicyServer.start)
+        assert "install_thread_excepthook()" in src
+
+
+# ---- satellite: shm cleanup under kill_env_worker -----------------------
+
+
+def _lint_scripted_factory(seed: int, env_index=None):
+    from torched_impala_tpu.envs.fake import ScriptedEnv
+
+    env = ScriptedEnv(episode_len=5)
+    env.task_id = 0 if env_index is None else env_index
+    return env
+
+
+class TestShmCleanupUnderKill:
+    def test_pool_segment_unlinked_after_worker_kill(self):
+        """Negative regression (ISSUE 7 satellite): the lifecycle
+        checker found no leak on the chaos-kill path, so prove it
+        dynamically — SIGKILL a worker mid-run (the kill_env_worker
+        fault's exact mechanism), let the pool repair it, close the
+        pool, and assert the SharedMemory NAME is gone from the
+        system (attach raises FileNotFoundError)."""
+        from multiprocessing import shared_memory
+
+        from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
+
+        pool = ProcessEnvPool(
+            env_factory=_lint_scripted_factory,
+            num_workers=2,
+            envs_per_worker=1,
+            obs_shape=(4,),
+            obs_dtype=np.float32,
+            base_seed=0,
+            max_restarts=4,
+        )
+        name = pool._shm.name
+        try:
+            pool.reset_all()
+            obs, rewards, dones, _ = pool.step_all(np.zeros(2, np.int32))
+            assert obs.shape == (2, 4)
+            # SIGKILL worker 0 — exactly what chaos kill_env_worker does.
+            os.kill(pool._procs[0].pid, signal.SIGKILL)
+            pool._procs[0].join(timeout=10)
+            deadline = time.monotonic() + 30
+            repaired = False
+            while time.monotonic() < deadline:
+                _, _, dones, _ = pool.step_all(np.zeros(2, np.int32))
+                if pool.restarts >= 1:
+                    repaired = True
+                    break
+            assert repaired, "pool never repaired the killed worker"
+            # The segment is still attachable while the pool lives.
+            probe = shared_memory.SharedMemory(name=name)
+            probe.close()
+        finally:
+            pool.close()
+        # After close(): close + unlink ran on every exit path — the
+        # name must be GONE (this is what the static no-unlink rule
+        # guarantees; here we prove it held under a worker kill).
+        with pytest.raises(FileNotFoundError):
+            shared_memory.SharedMemory(name=name)
+
+    def test_serving_ring_owner_unlinks_after_backpressure(self):
+        """Same proof for the serving shm ring's RingBackpressure path:
+        a client that dies in backpressure must not leak the segment —
+        the OWNING side unlinks at close regardless."""
+        from multiprocessing import shared_memory
+
+        from torched_impala_tpu.serving.shm_ring import (
+            RingBackpressure,
+            ShmRingClient,
+            ShmServingRing,
+        )
+
+        ring = ShmServingRing(
+            capacity=1, obs_shape=(4,), obs_dtype=np.float32
+        )
+        name = ring._shm.name
+        attached = ShmServingRing.attach(ring.descriptor())
+        client = ShmRingClient(attached)
+        client.submit(np.zeros(4, np.float32), True)
+        with pytest.raises(RingBackpressure):
+            # Nobody serves: the one slot stays REQUEST, the second
+            # submit hits backpressure and raises.
+            client.submit(
+                np.zeros(4, np.float32), True, timeout_s=0.05
+            )
+        attached.close()  # attach side: close only (no unlink)
+        ring.close()  # owner: close + unlink
+        with pytest.raises(FileNotFoundError):
+            shared_memory.SharedMemory(name=name)

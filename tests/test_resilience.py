@@ -829,20 +829,17 @@ def test_doctor_resilience_selfcheck_passes():
 
 
 def test_lint_flags_unprefixed_resilience_names(tmp_path):
-    """check_metric_names rule 3b: resilience/* metrics must pick a
-    sub-family prefix (checkpoint_/supervisor_/chaos_/recovery_)."""
-    import importlib.util
+    """impala-lint telemetry rule 3b (the former check_metric_names):
+    resilience/* metrics must pick a sub-family prefix
+    (checkpoint_/supervisor_/chaos_/recovery_). Migrated to the
+    tools.lint framework entrypoint (ISSUE 7)."""
+    import sys
 
-    spec = importlib.util.spec_from_file_location(
-        "check_metric_names_resilience",
-        os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "tools",
-            "check_metric_names.py",
-        ),
-    )
-    lint = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(lint)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.lint.metrics import legacy_check
+
     pkg = tmp_path / "torched_impala_tpu"
     pkg.mkdir()
     (tmp_path / "bench.py").write_text("")
@@ -850,5 +847,5 @@ def test_lint_flags_unprefixed_resilience_names(tmp_path):
         'reg.counter("resilience/orphan_series")\n'
         'reg.counter("resilience/checkpoint_bytes")\n'  # prefixed: clean
     )
-    errors = lint.check(str(tmp_path))
+    errors = legacy_check(str(tmp_path))
     assert len(errors) == 1 and "sub-family prefix" in errors[0]

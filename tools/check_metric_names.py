@@ -1,203 +1,46 @@
 #!/usr/bin/env python
-"""Lint telemetry metric AND trace event names across the codebase
-(ISSUE 2 satellite; trace grammar added by ISSUE 4).
+"""Thin CLI shim over the impala-lint telemetry checker (ISSUE 7).
 
-Statically scans `torched_impala_tpu/**/*.py` (and `bench.py`) for
-telemetry registration call sites — `.counter("...")`, `.gauge("...")`,
-`.timer("...")`, `.histogram("...")`, `.span("...")` — flight-recorder
-event call sites — `.instant("...")`, `.begin("...")`, `.end("...")`,
-`.complete("...")` (telemetry/tracing.py) — and for literal emitted
-keys (`"telemetry/..."` strings and `f"{PREFIX}/..."` interpolations),
-then asserts:
+The metric/trace name lint that used to live here moved into the
+unified static-analysis framework as ``tools/lint/metrics.py`` — same
+rules (grammar, type forks, resilience/serving sub-family prefixes,
+trace closed set), same message bodies, now with baselining and inline
+annotations shared with the thread-safety / jit-boundary /
+shm-lifecycle checkers. See docs/STATIC_ANALYSIS.md.
 
-1. every registered name matches the `<component>/<name>` slug grammar
-   (so every emitted key matches `telemetry/<component>/<name>[_suffix]`);
-2. no two call sites register the same name with DIFFERENT metric types
-   (a `span` counts as its backing `timer`) — a type fork would silently
-   split one series into two;
-3. every literal emitted key carries the `telemetry/` prefix and the same
-   grammar;
-3b. `resilience/*` names (the resilience subsystem multiplexes several
-   sub-families into the two-segment grammar — the registry rejects
-   three-segment names) use a pinned sub-family prefix
-   (`checkpoint_`/`supervisor_`/`chaos_`/`recovery_`), so the family
-   stays greppable as `resilience/checkpoint_*` etc.;
-3c. `serving/*` metric names (ISSUE 6) use the same discipline with the
-   serving sub-families (`request_`/`wave_`/`shadow_`/`client_`/
-   `version_`/`ring_`) — dashboards glob `serving/request_*` for the
-   client-visible latency story and `serving/wave_*` for the device
-   side;
-4. every trace event name follows the SAME `<component>/<name>` grammar
-   (the recorder enforces it at runtime too; trace components map to
-   Chrome-trace process rows, so a malformed name breaks the Perfetto
-   grouping). Trace phases are not types: the same name may appear as
-   instant and complete — only recorder-vs-METRIC grammar is shared,
-   `.span("...")` sites (registry or recorder) both count as the timer
-   series by design.
-4b. `serving/...` TRACE events are a closed set — `serving/request`
-   (submit→response, args {lid: c<slot>r<seq>, version, wave}),
-   `serving/wave` and `serving/shadow` — because trace consumers (the
-   lineage tooling, Perfetto queries in docs/SERVING.md) key on these
-   exact names; a new serving span must be added here AND documented.
+This file keeps the historical surface alive so existing invocations
+don't break:
 
-Static on purpose: the lint runs from the test suite
-(tests/test_telemetry.py) on every CI pass without spawning pools or
-initializing jax, and it sees DEAD call sites too (a name typo'd in a
-rarely-taken branch still fails). The registry enforces the same two
-rules at runtime as a backstop for dynamically-built names, which this
-scan cannot see.
+- ``python tools/check_metric_names.py``   (CLI, exit 0/1)
+- ``check(root) -> list[str]``             (the test-suite entrypoint)
 
-Exit code: 0 clean, 1 with findings (one per line on stderr).
+New call sites should use ``python -m tools.lint`` /
+``tools.lint.run_all`` instead.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
+from typing import List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# .counter("pool/restarts") / reg.span('learner/train_step') ...
-_REG_CALL = re.compile(
-    r"\.(counter|gauge|timer|histogram|span)\(\s*([\"'])([^\"']+)\2"
-)
-# Flight-recorder event sites: tracer.instant("ring/commit", ...),
-# tracer.complete("pool/worker_step", ...). Same slug grammar, no type
-# semantics (phases may mix freely on one name).
-_TRACE_CALL = re.compile(
-    r"\.(instant|begin|end|complete)\(\s*([\"'])([^\"']+)\2"
-)
-# Literal emitted keys: a quoted string that IS a key ("telemetry/...",
-# nothing else inside the quotes — prose mentioning keys is skipped) or
-# an f"{PREFIX}/..." interpolation.
-_LITERAL_KEY = re.compile(r"[\"']telemetry/([a-z0-9_/]+)[\"']")
-_PREFIX_KEY = re.compile(r"\{PREFIX\}/([a-z0-9_/]+)")
 
-# <component>/<name> for registrations; emitted keys additionally allow
-# the suffixes snapshot_into appends (_ms, _p95, ... — same charset).
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
+def _metrics_module():
+    # This script is commonly exec'd by path (tests use
+    # spec_from_file_location), so the repo root may not be importable
+    # yet — add it, then import the real checker.
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint import metrics
 
-# span() is sugar over timer() — the two share a series by design.
-_CANONICAL = {"span": "timer"}
-
-# resilience/<name> must pick a sub-family (rule 3b above): the component
-# aggregates checkpointing, supervision, chaos, and recovery series, and
-# an unprefixed name would orphan itself from every dashboard glob.
-RESILIENCE_PREFIXES = ("checkpoint_", "supervisor_", "chaos_", "recovery_")
-
-# serving/<name> sub-families (rule 3c): request-side, wave-side, shadow
-# scoring, client bookkeeping, version routing, and the shm ring.
-SERVING_PREFIXES = (
-    "request_", "wave_", "shadow_", "client_", "version_", "ring_",
-)
-
-# The closed serving trace-event set (rule 4b): the `serving/request`
-# span grammar (args {lid, version, wave}) is part of the serving
-# contract; consumers match these names literally.
-SERVING_TRACE_EVENTS = {
-    "serving/request", "serving/wave", "serving/shadow",
-}
-
-
-def _py_files(root: str) -> List[str]:
-    files = [os.path.join(root, "bench.py")]
-    pkg = os.path.join(root, "torched_impala_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        files.extend(
-            os.path.join(dirpath, f)
-            for f in filenames
-            if f.endswith(".py")
-        )
-    return [f for f in files if os.path.exists(f)]
+    return metrics
 
 
 def check(root: str = REPO) -> List[str]:
     """Return a list of human-readable findings (empty = clean)."""
-    errors: List[str] = []
-    # name -> (canonical kind, first site)
-    seen: Dict[str, Tuple[str, str]] = {}
-    machinery = {
-        # These define the machinery; their docstring examples would
-        # read as registrations/events.
-        os.path.join("torched_impala_tpu", "telemetry", "registry.py"),
-        os.path.join("torched_impala_tpu", "telemetry", "tracing.py"),
-    }
-    for path in sorted(_py_files(root)):
-        rel = os.path.relpath(path, root)
-        if rel in machinery:
-            continue
-        with open(path, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                site = f"{rel}:{lineno}"
-                for kind, _q, name in _REG_CALL.findall(line):
-                    kind = _CANONICAL.get(kind, kind)
-                    if not NAME_RE.match(name):
-                        errors.append(
-                            f"{site}: {kind} name {name!r} does not "
-                            f"match <component>/<name> "
-                            f"({NAME_RE.pattern})"
-                        )
-                        continue
-                    if name.startswith("resilience/") and not name.split(
-                        "/", 1
-                    )[1].startswith(RESILIENCE_PREFIXES):
-                        errors.append(
-                            f"{site}: resilience metric {name!r} must "
-                            f"use a sub-family prefix "
-                            f"{RESILIENCE_PREFIXES}"
-                        )
-                        continue
-                    if name.startswith("serving/") and not name.split(
-                        "/", 1
-                    )[1].startswith(SERVING_PREFIXES):
-                        errors.append(
-                            f"{site}: serving metric {name!r} must "
-                            f"use a sub-family prefix "
-                            f"{SERVING_PREFIXES}"
-                        )
-                        continue
-                    prev = seen.get(name)
-                    if prev is None:
-                        seen[name] = (kind, site)
-                    elif prev[0] != kind:
-                        errors.append(
-                            f"{site}: {name!r} registered as {kind} "
-                            f"but {prev[1]} registered it as {prev[0]}"
-                        )
-                for kind, _q, name in _TRACE_CALL.findall(line):
-                    if not NAME_RE.match(name):
-                        errors.append(
-                            f"{site}: trace {kind} name {name!r} does "
-                            f"not match <component>/<name> "
-                            f"({NAME_RE.pattern})"
-                        )
-                        continue
-                    if (
-                        name.startswith("serving/")
-                        and name not in SERVING_TRACE_EVENTS
-                    ):
-                        errors.append(
-                            f"{site}: serving trace event {name!r} is "
-                            f"not in the pinned set "
-                            f"{sorted(SERVING_TRACE_EVENTS)} (rule 4b)"
-                        )
-                for m in _LITERAL_KEY.finditer(line):
-                    if not NAME_RE.match(m.group(1)):
-                        errors.append(
-                            f"{site}: literal key "
-                            f"'telemetry/{m.group(1)}' does not match "
-                            f"telemetry/<component>/<name>"
-                        )
-                for m in _PREFIX_KEY.finditer(line):
-                    if not NAME_RE.match(m.group(1)):
-                        errors.append(
-                            f"{site}: emitted key '{{PREFIX}}/"
-                            f"{m.group(1)}' does not match "
-                            f"telemetry/<component>/<name>"
-                        )
-    return errors
+    return _metrics_module().legacy_check(root)
 
 
 def main() -> int:

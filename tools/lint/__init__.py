@@ -1,0 +1,42 @@
+"""impala-lint: static-analysis suite for concurrency, jit-boundary,
+shm-lifecycle, and telemetry-grammar correctness.
+
+Run ``python -m tools.lint`` from the repo root (exit 0 = clean), or
+call :func:`run_all` (tier-1 does, via tests/test_lint.py). Rule
+catalog, annotation grammar, and baselining workflow:
+docs/STATIC_ANALYSIS.md.
+"""
+
+from tools.lint.core import (
+    DEFAULT_BASELINE,
+    DEFAULT_ROOTS,
+    REPO,
+    BaselineEntry,
+    Directive,
+    Finding,
+    LintResult,
+    SourceFile,
+    apply_baseline,
+    checkers,
+    load_baseline,
+    load_files,
+    parse_directives,
+    run_all,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "DEFAULT_ROOTS",
+    "REPO",
+    "BaselineEntry",
+    "Directive",
+    "Finding",
+    "LintResult",
+    "SourceFile",
+    "apply_baseline",
+    "checkers",
+    "load_baseline",
+    "load_files",
+    "parse_directives",
+    "run_all",
+]

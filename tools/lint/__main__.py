@@ -1,0 +1,102 @@
+"""CLI: ``python -m tools.lint [--checker NAME]... [--verbose]``.
+
+Exit codes: 0 clean (baselined findings allowed), 1 active findings,
+2 usage/framework error. Stale baseline entries print as warnings —
+delete them when the underlying finding is fixed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.lint.core import (
+    DEFAULT_BASELINE,
+    DEFAULT_ROOTS,
+    REPO,
+    checkers,
+    run_all,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="impala-lint",
+        description=(
+            "static-analysis suite: thread-safety, jit-boundary, "
+            "shm-lifecycle, telemetry grammar (docs/STATIC_ANALYSIS.md)"
+        ),
+    )
+    parser.add_argument(
+        "--root", default=REPO, help="repo root to scan (default: repo)"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="suppression file (default: tools/lint/baseline.txt); "
+        "'none' disables",
+    )
+    parser.add_argument(
+        "--checker",
+        action="append",
+        choices=sorted(checkers()),
+        help="run only this checker (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--verbose",
+        action="store_true",
+        help="also print baselined (suppressed) findings",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from tools.lint import jitb, metrics, shm, threads
+
+        for mod in (threads, jitb, shm, metrics):
+            for rule, desc in sorted(mod.RULES.items()):
+                print(f"{rule:40s} {desc}")
+        return 0
+
+    baseline = None if args.baseline == "none" else args.baseline
+    try:
+        result = run_all(
+            args.root,
+            roots=DEFAULT_ROOTS,
+            baseline_path=baseline,
+            only=args.checker,
+        )
+    except (KeyError, ValueError) as e:
+        print(f"impala-lint: error: {e}", file=sys.stderr)
+        return 2
+
+    for f in result.findings:
+        print(f.format(), file=sys.stderr)
+    if args.verbose:
+        for f, entry in result.suppressed:
+            print(
+                f"{f.format()}  [baselined: {entry.justification}]",
+                file=sys.stderr,
+            )
+    for entry in result.stale_baseline:
+        print(
+            f"impala-lint: warning: stale baseline entry "
+            f"(baseline.txt:{entry.line}) {entry.rule} {entry.key} — "
+            "the finding no longer fires; delete the line",
+            file=sys.stderr,
+        )
+    n = len(result.findings)
+    print(
+        f"impala-lint: {'FAIL' if n else 'OK'} ({n} active finding"
+        f"{'s' if n != 1 else ''}, {len(result.suppressed)} baselined, "
+        f"{len(result.stale_baseline)} stale baseline entr"
+        f"{'ies' if len(result.stale_baseline) != 1 else 'y'})",
+        file=sys.stderr,
+    )
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

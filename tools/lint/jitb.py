@@ -1,0 +1,542 @@
+"""jit-boundary checker: host syncs in jitted code and hot loops, and
+donate_argnums liveness at call sites.
+
+The ~707k frames/s/chip headline depends on the learner hot path staying
+one asynchronously-dispatched XLA program per step: a single stray
+``.item()`` / ``float(device_scalar)`` / ``np.asarray`` forces a device
+round trip per step and quietly erases the pipeline overlap (the exact
+failure class TorchBeast §2 and Podracer both call out). Three rules:
+
+1. **host-sync-in-jit** — inside a jit-compiled function (decorated
+   ``@jax.jit`` / ``@partial(jax.jit, ...)``, or passed to
+   ``jax.jit(...)`` / ``jax.pmap(...)``, resolved through local aliases
+   and ``self.<method>`` references, plus the closure of self-method
+   calls from those roots), flag calls that either crash at trace time
+   or silently freeze a traced value: ``.item()``,
+   ``block_until_ready``, ``jax.device_get``, ``np.asarray`` /
+   ``np.array`` / ``np.copyto``, ``print`` (fires at TRACE time, not
+   per step — almost never what was meant; use ``jax.debug.print``),
+   ``float()/int()/bool()`` on non-literals, and ``time.*`` reads
+   (frozen into the compiled program as constants).
+
+2. **host-sync-in-hot-loop** — functions annotated ``# lint: hot-loop``
+   (the learner step/batcher loops, actor unroll bodies, serving wave
+   path) must not contain ``.item()``, ``block_until_ready``,
+   ``jax.device_get`` or ``print``: these synchronize or stall the very
+   loop the pipeline overlaps. Deliberate syncs (log-interval
+   materialization) carry an inline ``allow``. Non-transitive by
+   design: helpers a hot loop calls may legitimately block (e.g. ring
+   recycling waits out a transfer) — the annotation marks exactly the
+   bodies that must stay clean.
+
+3. **donated-arg-alive** — for callables jitted with
+   ``donate_argnums``, every call site must pass donated positions
+   arguments that are DEAD afterwards: the buffer is aliased by XLA, so
+   a later read sees garbage ("Array has been deleted" at best).  An
+   argument counts as dead when the call's result is assigned back over
+   it, or the name/attribute is never read later in the function
+   (lexically — a loop that re-reads it next iteration should rebind
+   it, which this rule's line-order approximation also accepts only if
+   the rebind IS the call result).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import Finding, SourceFile
+
+RULES = {
+    "jit-boundary/host-sync-in-jit": (
+        "host-side call inside a jit-compiled function (host sync or "
+        "trace-time freeze)"
+    ),
+    "jit-boundary/host-sync-in-hot-loop": (
+        "synchronizing call inside a '# lint: hot-loop' function"
+    ),
+    "jit-boundary/donated-arg-alive": (
+        "argument at a donate_argnums position is still used after the "
+        "call (its buffer was donated to XLA)"
+    ),
+}
+
+_JIT_NAMES = {"jit", "pmap", "pjit"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_HOST_FNS = {"asarray", "array", "copyto", "save", "savez"}
+_TIME_FNS = {"time", "monotonic", "perf_counter", "monotonic_ns", "sleep"}
+
+
+def _dotted(node: ast.expr) -> str:
+    """'jax.jit' for Attribute(Name jax, jit); '' when not a plain
+    dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = _dotted(node.func)
+    return name in _JIT_NAMES or (
+        "." in name and name.split(".")[-1] in _JIT_NAMES
+    )
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _Scope:
+    """One class (or the module top level): its function defs and the
+    jit roots discovered in it."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        # names (method or local function) that are jit roots
+        self.jit_roots: Set[str] = set()
+        # donated attr/local name -> donate positions
+        self.donated: Dict[str, Tuple[int, ...]] = {}
+
+
+def _literal(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant)
+
+
+def _resolve_candidates(
+    expr: ast.expr, local_assigns: Dict[str, List[ast.expr]]
+) -> List[str]:
+    """Candidate function names an expression may refer to: handles
+    Name, self.<attr>, and IfExp over those (the learner's
+    ``step_impl = a if fused else b`` pattern), following one level of
+    local Name assignment."""
+    out: List[str] = []
+    if isinstance(expr, ast.IfExp):
+        out += _resolve_candidates(expr.body, local_assigns)
+        out += _resolve_candidates(expr.orelse, local_assigns)
+        return out
+    attr = _self_attr(expr)
+    if attr is not None:
+        return [attr]
+    if isinstance(expr, ast.Name):
+        if expr.id in local_assigns:
+            for v in local_assigns[expr.id]:
+                out += _resolve_candidates(v, {})
+            if out:
+                return out
+        return [expr.id]
+    return out
+
+
+def _collect_scope(body: Sequence[ast.stmt], sf: SourceFile) -> _Scope:
+    scope = _Scope()
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope.functions[stmt.name] = stmt
+            for dec in stmt.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jit_call(dec)
+                    or any(
+                        _is_jit_call(a)
+                        for a in dec.args
+                        if isinstance(a, ast.Call)
+                    )
+                    or any(
+                        _dotted(a).split(".")[-1] in _JIT_NAMES
+                        for a in dec.args
+                        if _dotted(a)
+                    )
+                ):
+                    scope.jit_roots.add(stmt.name)
+                elif _dotted(dec).split(".")[-1] in _JIT_NAMES:
+                    scope.jit_roots.add(stmt.name)
+    # jax.jit(X, ...) call sites anywhere inside this scope's functions.
+    for fn in list(scope.functions.values()):
+        local_assigns: Dict[str, List[ast.expr]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.targets[0], ast.Name
+            ):
+                local_assigns.setdefault(node.targets[0].id, []).append(
+                    node.value
+                )
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                continue
+            if not node.args:
+                continue
+            for cand in _resolve_candidates(node.args[0], local_assigns):
+                scope.jit_roots.add(cand)
+            donate: Tuple[int, ...] = ()
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums":
+                    try:
+                        v = ast.literal_eval(kw.value)
+                        donate = (
+                            tuple(v) if isinstance(v, (tuple, list))
+                            else (int(v),)
+                        )
+                    except Exception:
+                        donate = ()
+            if donate:
+                # Where does the jitted callable land? self.X = jax.jit(...)
+                # or  X = jax.jit(...).
+                parent = _assign_target_of(fn, node)
+                if parent is not None:
+                    scope.donated[parent] = donate
+    return scope
+
+
+def _assign_target_of(
+    fn: ast.FunctionDef, call: ast.Call
+) -> Optional[str]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.value is call:
+            tgt = node.targets[0]
+            attr = _self_attr(tgt)
+            if attr is not None:
+                return attr
+            if isinstance(tgt, ast.Name):
+                return tgt.id
+    return None
+
+
+def _self_calls(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr is not None:
+                out.add(attr)
+            elif isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+    return out
+
+
+def _traced_functions(scope: _Scope) -> Set[str]:
+    """jit roots plus the closure of (self-)calls they make, restricted
+    to functions defined in this scope."""
+    seen: Set[str] = set()
+    frontier = [n for n in scope.jit_roots if n in scope.functions]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for callee in _self_calls(scope.functions[name]):
+            if callee in scope.functions and callee not in seen:
+                frontier.append(callee)
+    return seen
+
+
+def _references_any(node: ast.expr, names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+def _host_sync_reason(
+    node: ast.Call, in_jit: bool, params: Set[str] = frozenset()
+) -> Optional[str]:
+    """Why this call is a host sync (None = clean). `in_jit` enables
+    the trace-time-only rules (float()/np.*/time.*) that are legitimate
+    in plain hot-loop Python. `params` are the jitted function's
+    argument names: float()/int() only fire on expressions derived from
+    them (a closure-captured Python scalar is a static constant, not a
+    traced value)."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "item" and not node.args:
+            return ".item() forces a device->host transfer"
+        if fn.attr == "block_until_ready":
+            return ".block_until_ready() blocks the host on the device"
+        dotted = _dotted(fn)
+        if dotted == "jax.device_get":
+            return "jax.device_get materializes on host"
+        if dotted.startswith("jax.block_until_ready"):
+            return "jax.block_until_ready blocks the host"
+        if in_jit:
+            parts = dotted.split(".")
+            if (
+                len(parts) == 2
+                and parts[0] in _NP_MODULES
+                and parts[1] in _NP_HOST_FNS
+            ):
+                return (
+                    f"{dotted} inside jit materializes/freezes the "
+                    "traced value on host (use jnp)"
+                )
+            if (
+                len(parts) == 2
+                and parts[0] == "time"
+                and parts[1] in _TIME_FNS
+            ):
+                return (
+                    f"{dotted}() inside jit is evaluated ONCE at trace "
+                    "time and frozen into the program"
+                )
+    if isinstance(fn, ast.Name):
+        if fn.id == "print":
+            return (
+                "print inside jit fires at trace time only (use "
+                "jax.debug.print)" if in_jit
+                else "print stalls the hot loop on stdout"
+            )
+        if (
+            in_jit
+            and fn.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and not _literal(node.args[0])
+            and _references_any(node.args[0], params)
+        ):
+            return (
+                f"{fn.id}() on a traced value forces a concrete host "
+                "read at trace time"
+            )
+    return None
+
+
+def _check_body(
+    sf: SourceFile,
+    fn: ast.FunctionDef,
+    qual: str,
+    in_jit: bool,
+    findings: List[Finding],
+) -> None:
+    rule = (
+        "jit-boundary/host-sync-in-jit"
+        if in_jit
+        else "jit-boundary/host-sync-in-hot-loop"
+    )
+    params: Set[str] = {
+        a.arg
+        for a in (
+            fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        )
+    } - {"self"}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _host_sync_reason(node, in_jit, params)
+        if reason is None:
+            continue
+        where = "jit-compiled" if in_jit else "hot-loop"
+        findings.append(
+            Finding(
+                rule=rule,
+                path=sf.rel,
+                line=node.lineno,
+                message=f"{reason} (inside {where} {qual}())",
+                key=f"{sf.rel}::{qual}:{_call_label(node)}",
+            )
+        )
+
+
+def _call_label(node: ast.Call) -> str:
+    d = _dotted(node.func)
+    if d:
+        return d
+    if isinstance(node.func, ast.Attribute):
+        return f".{node.func.attr}"
+    return "<call>"
+
+
+def _is_hot_loop(sf: SourceFile, fn: ast.FunctionDef) -> bool:
+    end = fn.body[0].lineno if fn.body else fn.lineno + 1
+    for line in range(fn.lineno, end):
+        if sf.directives(line, "hot-loop"):
+            return True
+    return False
+
+
+def _check_donation(
+    sf: SourceFile,
+    scope: _Scope,
+    findings: List[Finding],
+) -> None:
+    """At each call of a donated callable, donated-position args must be
+    rebound by the result or unread afterwards."""
+    if not scope.donated:
+        return
+    for fname, fn in scope.functions.items():
+        local_assigns: Dict[str, List[ast.expr]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.targets[0], ast.Name
+            ):
+                local_assigns.setdefault(node.targets[0].id, []).append(
+                    node.value
+                )
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            callee_names = _resolve_candidates(call.func, local_assigns)
+            donate: Set[int] = set()
+            donated_callee = None
+            for cn in callee_names:
+                if cn in scope.donated:
+                    donate |= set(scope.donated[cn])
+                    donated_callee = cn
+            if not donate:
+                continue
+            targets = _flat_target_exprs(node.targets)
+            target_syms = {_sym(t) for t in targets} - {None}
+            for pos in sorted(donate):
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                sym = _sym(arg)
+                if sym is None:
+                    continue  # complex expression: can't track liveness
+                if sym in target_syms:
+                    continue  # rebound by the result: dead, correct
+                # Any later read of the symbol in this function?
+                later = _reads_after(fn, sym, node.lineno)
+                if later is not None:
+                    findings.append(
+                        Finding(
+                            rule="jit-boundary/donated-arg-alive",
+                            path=sf.rel,
+                            line=call.lineno,
+                            message=(
+                                f"arg {pos} ({sym}) of donated call "
+                                f"{donated_callee}() is read again at "
+                                f"line {later} — the buffer was "
+                                "donated to XLA and no longer holds "
+                                "this value; rebind it from the "
+                                "result or drop it from donate_argnums"
+                            ),
+                            key=f"{sf.rel}::{fname}:{sym}",
+                        )
+                    )
+
+
+def _flat_target_exprs(targets: Sequence[ast.expr]) -> List[ast.expr]:
+    out: List[ast.expr] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(_flat_target_exprs(t.elts))
+        else:
+            out.append(t)
+    return out
+
+
+def _sym(node: ast.expr) -> Optional[str]:
+    """Stable symbol for liveness tracking: 'x' or 'self.x'."""
+    if isinstance(node, ast.Name):
+        return node.id
+    attr = _self_attr(node)
+    if attr is not None:
+        return f"self.{attr}"
+    return None
+
+
+def _reads_after(
+    fn: ast.FunctionDef, sym: str, line: int
+) -> Optional[int]:
+    for node in ast.walk(fn):
+        if node is None or not hasattr(node, "lineno"):
+            continue
+        if node.lineno <= line:
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if sym == node.id:
+                return node.lineno
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if _sym(node) == sym:
+                return node.lineno
+    return None
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        scopes: List[Tuple[str, _Scope]] = [
+            ("", _collect_scope(sf.tree.body, sf))
+        ]
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                scopes.append(
+                    (node.name, _collect_scope(node.body, sf))
+                )
+        for prefix, scope in scopes:
+            traced = _traced_functions(scope)
+            for name in sorted(traced):
+                fn = scope.functions[name]
+                qual = f"{prefix}.{name}" if prefix else name
+                _check_body(sf, fn, qual, True, findings)
+            for name, fn in scope.functions.items():
+                if name in traced:
+                    continue
+                if _is_hot_loop(sf, fn):
+                    qual = f"{prefix}.{name}" if prefix else name
+                    _check_body(sf, fn, qual, False, findings)
+            _check_donation(sf, scope, findings)
+        # Inner jitted defs (e.g. a `def _wave(...)` inside a method,
+        # passed to jax.jit in the same method) live one level down:
+        # scan every function's local defs too.
+        for prefix, scope in scopes:
+            for name, fn in scope.functions.items():
+                inner = _collect_scope(
+                    [
+                        n
+                        for n in ast.walk(fn)
+                        if isinstance(
+                            n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                        and n is not fn
+                    ],
+                    sf,
+                )
+                # jit roots referenced from the OUTER body too
+                # (jax.jit(_wave) appears in `fn`, not in the inner def).
+                local_assigns: Dict[str, List[ast.expr]] = {}
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and isinstance(
+                        node.targets[0], ast.Name
+                    ):
+                        local_assigns.setdefault(
+                            node.targets[0].id, []
+                        ).append(node.value)
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and _is_jit_call(node):
+                        if node.args:
+                            for cand in _resolve_candidates(
+                                node.args[0], local_assigns
+                            ):
+                                inner.jit_roots.add(cand)
+                for name2 in sorted(_traced_functions(inner)):
+                    fn2 = inner.functions[name2]
+                    qual = (
+                        f"{prefix}.{name}.{name2}"
+                        if prefix
+                        else f"{name}.{name2}"
+                    )
+                    _check_body(sf, fn2, qual, True, findings)
+    # De-duplicate (an inner def can be visited via two paths).
+    seen: Set[Tuple[str, int, str, str]] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        ident = (f.path, f.line, f.rule, f.message)
+        if ident not in seen:
+            seen.add(ident)
+            unique.append(f)
+    return unique

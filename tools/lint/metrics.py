@@ -1,0 +1,204 @@
+"""telemetry checker: metric AND trace event name grammar.
+
+The former ``tools/check_metric_names.py`` (ISSUE 2 satellite, trace
+grammar from ISSUE 4, sub-family rules 3b/3c from ISSUEs 5/6), folded
+into the impala-lint framework — same rules, same message bodies, now
+emitting :class:`Finding`s so baselining/annotation work uniformly.
+The old script remains as a thin CLI shim over this module.
+
+Rules (rule ids in parentheses):
+
+1. every registered metric name — ``.counter("...")`` / ``.gauge`` /
+   ``.timer`` / ``.histogram`` / ``.span`` — matches the
+   ``<component>/<name>`` slug grammar (``telemetry/name-grammar``);
+2. no two call sites register one name with DIFFERENT metric types
+   (a ``span`` counts as its backing ``timer``) — a type fork silently
+   splits one series into two (``telemetry/type-fork``);
+3. literal emitted keys (``"telemetry/..."`` strings,
+   ``f"{PREFIX}/..."`` interpolations) carry the same grammar
+   (``telemetry/literal-key``);
+3b/3c. ``resilience/*`` and ``serving/*`` names use their pinned
+   sub-family prefixes (``telemetry/subfamily-prefix``);
+4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
+   ``.complete`` — follow the same slug grammar
+   (``telemetry/trace-grammar``);
+4b. ``serving/*`` TRACE events are a closed set
+   (``telemetry/trace-closed-set``).
+
+Static on purpose: runs from tier-1 without initializing jax and sees
+dead call sites (a typo'd name in a rarely-taken branch still fails).
+The registry/recorder enforce the same grammar at runtime as a backstop
+for dynamically-built names this scan cannot see.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from tools.lint.core import Finding, SourceFile
+
+RULES = {
+    "telemetry/name-grammar": "metric name violates <component>/<name>",
+    "telemetry/type-fork": "one metric name registered as two types",
+    "telemetry/literal-key": "literal emitted key violates the grammar",
+    "telemetry/subfamily-prefix": (
+        "resilience/* or serving/* name lacks its pinned sub-family "
+        "prefix"
+    ),
+    "telemetry/trace-grammar": "trace event name violates the grammar",
+    "telemetry/trace-closed-set": (
+        "serving/* trace event outside the pinned set"
+    ),
+}
+
+# .counter("pool/restarts") / reg.span('learner/train_step') ...
+_REG_CALL = re.compile(
+    r"\.(counter|gauge|timer|histogram|span)\(\s*([\"'])([^\"']+)\2"
+)
+# Flight-recorder event sites; same slug grammar, no type semantics.
+_TRACE_CALL = re.compile(
+    r"\.(instant|begin|end|complete)\(\s*([\"'])([^\"']+)\2"
+)
+_LITERAL_KEY = re.compile(r"[\"']telemetry/([a-z0-9_/]+)[\"']")
+_PREFIX_KEY = re.compile(r"\{PREFIX\}/([a-z0-9_/]+)")
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
+
+_CANONICAL = {"span": "timer"}
+
+RESILIENCE_PREFIXES = ("checkpoint_", "supervisor_", "chaos_", "recovery_")
+SERVING_PREFIXES = (
+    "request_", "wave_", "shadow_", "client_", "version_", "ring_",
+)
+SERVING_TRACE_EVENTS = {
+    "serving/request", "serving/wave", "serving/shadow",
+}
+
+# These files define the machinery; their docstring examples would read
+# as registrations/events.
+MACHINERY = {
+    os.path.join("torched_impala_tpu", "telemetry", "registry.py").replace(
+        os.sep, "/"
+    ),
+    os.path.join("torched_impala_tpu", "telemetry", "tracing.py").replace(
+        os.sep, "/"
+    ),
+}
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    # name -> (canonical kind, first site)
+    seen: Dict[str, Tuple[str, str]] = {}
+    for sf in sorted(files, key=lambda s: s.rel):
+        if sf.rel in MACHINERY:
+            continue
+        for lineno, line in enumerate(sf.lines, 1):
+            site = f"{sf.rel}:{lineno}"
+
+            def out(rule: str, name: str, message: str) -> None:
+                findings.append(
+                    Finding(
+                        rule=rule,
+                        path=sf.rel,
+                        line=lineno,
+                        message=message,
+                        key=f"{sf.rel}::{name}",
+                    )
+                )
+
+            for kind, _q, name in _REG_CALL.findall(line):
+                kind = _CANONICAL.get(kind, kind)
+                if not NAME_RE.match(name):
+                    out(
+                        "telemetry/name-grammar",
+                        name,
+                        f"{kind} name {name!r} does not match "
+                        f"<component>/<name> ({NAME_RE.pattern})",
+                    )
+                    continue
+                if name.startswith("resilience/") and not name.split(
+                    "/", 1
+                )[1].startswith(RESILIENCE_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"resilience metric {name!r} must use a "
+                        f"sub-family prefix {RESILIENCE_PREFIXES}",
+                    )
+                    continue
+                if name.startswith("serving/") and not name.split(
+                    "/", 1
+                )[1].startswith(SERVING_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"serving metric {name!r} must use a "
+                        f"sub-family prefix {SERVING_PREFIXES}",
+                    )
+                    continue
+                prev = seen.get(name)
+                if prev is None:
+                    seen[name] = (kind, site)
+                elif prev[0] != kind:
+                    out(
+                        "telemetry/type-fork",
+                        name,
+                        f"{name!r} registered as {kind} but {prev[1]} "
+                        f"registered it as {prev[0]}",
+                    )
+            for kind, _q, name in _TRACE_CALL.findall(line):
+                if not NAME_RE.match(name):
+                    out(
+                        "telemetry/trace-grammar",
+                        name,
+                        f"trace {kind} name {name!r} does not match "
+                        f"<component>/<name> ({NAME_RE.pattern})",
+                    )
+                    continue
+                if (
+                    name.startswith("serving/")
+                    and name not in SERVING_TRACE_EVENTS
+                ):
+                    out(
+                        "telemetry/trace-closed-set",
+                        name,
+                        f"serving trace event {name!r} is not in the "
+                        f"pinned set {sorted(SERVING_TRACE_EVENTS)} "
+                        f"(rule 4b)",
+                    )
+            for m in _LITERAL_KEY.finditer(line):
+                if not NAME_RE.match(m.group(1)):
+                    out(
+                        "telemetry/literal-key",
+                        f"telemetry/{m.group(1)}",
+                        f"literal key 'telemetry/{m.group(1)}' does "
+                        f"not match telemetry/<component>/<name>",
+                    )
+            for m in _PREFIX_KEY.finditer(line):
+                if not NAME_RE.match(m.group(1)):
+                    out(
+                        "telemetry/literal-key",
+                        f"PREFIX/{m.group(1)}",
+                        f"emitted key '{{PREFIX}}/{m.group(1)}' does "
+                        f"not match telemetry/<component>/<name>",
+                    )
+    return findings
+
+
+def legacy_check(root: str) -> List[str]:
+    """The pre-framework surface: scan `root` (torched_impala_tpu/**
+    + bench.py) and return human-readable strings — one per finding,
+    ``path:line: message`` — exactly like tools/check_metric_names.py
+    always did. The CLI shim and pre-existing tests call this."""
+    from tools.lint.core import (
+        DEFAULT_ROOTS,
+        apply_inline_allows,
+        load_files,
+    )
+
+    files = load_files(root, DEFAULT_ROOTS)
+    findings = apply_inline_allows(files, check(files))
+    return [f"{f.path}:{f.line}: {f.message}" for f in findings]

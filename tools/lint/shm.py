@@ -1,0 +1,222 @@
+"""shm-lifecycle checker: SharedMemory create/close/unlink pairing.
+
+A leaked ``multiprocessing.shared_memory.SharedMemory`` segment outlives
+the process in /dev/shm — at the env-pool scale (one segment per pool,
+one per serving ring connection) a crash loop fills the host's shm and
+takes every later run down with it. Three rules, keyed to how the repo
+uses segments (env_pool.py lanes, serving/shm_ring.py slots):
+
+1. **no-close** — a class that stores a SharedMemory on ``self.<attr>``
+   must have some method calling ``self.<attr>.close()``.
+2. **no-unlink** — when any such create passes ``create=True`` (the
+   OWNING side), some method must also call ``self.<attr>.unlink()``
+   (the owner removes the name; attach-only classes must NOT be forced
+   to).
+3. **local-no-finally** — a function-local SharedMemory (worker attach
+   pattern) must close in a ``finally`` block (or a ``with``
+   statement), so every exit path — including the error-report path of
+   a dying worker — unmaps the segment.
+
+A class-level create also wants a ``__del__`` safety net, but that is a
+style call the runtime classes already follow; the checker enforces the
+three hard rules only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import Finding, SourceFile
+
+RULES = {
+    "shm-lifecycle/no-close": (
+        "class creates a SharedMemory attribute but never closes it"
+    ),
+    "shm-lifecycle/no-unlink": (
+        "class owns (create=True) a SharedMemory but never unlinks it"
+    ),
+    "shm-lifecycle/local-no-finally": (
+        "function-local SharedMemory is not closed in a finally/with"
+    ),
+}
+
+
+def _is_shm_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    return name == "SharedMemory"
+
+
+def _has_create_true(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "create":
+            try:
+                return bool(ast.literal_eval(kw.value))
+            except Exception:
+                return True  # dynamic: assume it CAN own
+    return False
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _method_calls_on(
+    cls: ast.ClassDef, attr: str, method_name: str
+) -> bool:
+    """Does any method call self.<attr>.<method_name>() anywhere?"""
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == method_name
+            and _self_attr(fn.value) == attr
+        ):
+            return True
+    return False
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    creates: Dict[str, Tuple[int, bool]] = {}  # attr -> (line, owns)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _is_shm_call(node.value):
+            continue
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            line, owns = creates.get(attr, (node.lineno, False))
+            creates[attr] = (
+                min(line, node.lineno),
+                owns or _has_create_true(node.value),
+            )
+    out: List[Finding] = []
+    for attr, (line, owns) in sorted(creates.items()):
+        key = f"{sf.rel}::{cls.name}.{attr}"
+        if not _method_calls_on(cls, attr, "close"):
+            out.append(
+                Finding(
+                    rule="shm-lifecycle/no-close",
+                    path=sf.rel,
+                    line=line,
+                    message=(
+                        f"{cls.name}.{attr} holds a SharedMemory but no "
+                        f"method calls self.{attr}.close() — the "
+                        "mapping leaks on every teardown path"
+                    ),
+                    key=key,
+                )
+            )
+        if owns and not _method_calls_on(cls, attr, "unlink"):
+            out.append(
+                Finding(
+                    rule="shm-lifecycle/no-unlink",
+                    path=sf.rel,
+                    line=line,
+                    message=(
+                        f"{cls.name}.{attr} is created with create=True "
+                        f"(owning side) but no method calls "
+                        f"self.{attr}.unlink() — the segment outlives "
+                        "the process in /dev/shm"
+                    ),
+                    key=key,
+                )
+            )
+    return out
+
+
+def _finally_closes(fn: ast.AST, name: str) -> bool:
+    """Is `name.close()` called inside some try's finalbody (or is the
+    segment managed by a with/contextlib.closing)?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "close"
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == name
+                    ):
+                        return True
+        if isinstance(node, ast.With):
+            for item in node.items:
+                # with closing(shm) / with shm: either form manages it.
+                expr = item.context_expr
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+    return False
+
+
+def _check_function_locals(
+    sf: SourceFile, fn: ast.FunctionDef, qual: str
+) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _is_shm_call(node.value):
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue  # self-attr creates are the class rules' job
+        if tgt.id in seen:
+            continue
+        seen.add(tgt.id)
+        if _is_shm_call(node.value) and isinstance(node.value, ast.Call):
+            if not _finally_closes(fn, tgt.id):
+                out.append(
+                    Finding(
+                        rule="shm-lifecycle/local-no-finally",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"local SharedMemory {tgt.id!r} in {qual}() "
+                            "is not closed in a finally/with — an "
+                            "exception between create and close leaks "
+                            "the mapping"
+                        ),
+                        key=f"{sf.rel}::{qual}.{tgt.id}",
+                    )
+                )
+    return out
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        # Only bother when the file touches shared_memory at all.
+        if "SharedMemory" not in sf.text:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(sf, node))
+        # Function-local (Name-bound) segments: every function,
+        # module-level or method — the class rules above only cover
+        # self-attribute segments.
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(
+                    _check_function_locals(sf, node, node.name)
+                )
+    return findings

@@ -1,0 +1,604 @@
+"""thread-safety checker: cross-thread attribute guarding + lock order.
+
+The codebase runs ~30 lock/thread sites (learner batcher, async
+checkpointer, serving waves, watchdog, shm rings) and the failure mode
+TorchBeast/Podracer both warn about is the silent one: a background
+thread mutates state the foreground reads, nobody crashes, throughput
+quietly rots. This checker machine-checks two invariants per class:
+
+1. **unguarded-attr / mixed-locks** — every attribute that is (a)
+   mutated outside ``__init__`` and (b) reachable from more than one
+   thread group must have all its writes under ONE declared lock
+   (``with self.<lock>:`` lexically, or a method-level
+   ``# lint: guarded-by(<lock>)`` declaring the caller holds it), be a
+   thread-safe container assigned once in ``__init__`` (Event / Queue /
+   deque / Condition...), or carry an explicit
+   ``# lint: guarded-by(gil)`` annotation on its ``__init__`` line
+   (single bytecode-atomic flag — a documented decision, not an
+   accident).
+
+   Thread groups are derived statically: each
+   ``threading.Thread(target=self._x)`` call makes ``_x`` (and every
+   method it transitively self-calls) a background group; everything
+   else is the foreground group. A method reachable from both runs in
+   both. Cross-OBJECT threading (an actor thread calling
+   ``learner.enqueue``) is out of scope — the public surface of a class
+   touched by external threads should use the same locks, and the
+   in-class analysis already covers those attributes when the class
+   also spawns threads.
+
+2. **lock-cycle** — the lock-acquisition-order graph: an edge A -> B
+   whenever B is acquired while A is held (lexically nested ``with``
+   blocks, plus one level of interprocedural closure through self-method
+   calls). Any cycle — including a self-cycle, i.e. re-acquiring a
+   non-reentrant lock you already hold — is a deadlock waiting for its
+   schedule, and fails the lint. The graph spans every scanned file, so
+   learner/serving/resilience/traj_ring locks live in ONE ordering.
+
+Declared locks are attributes assigned ``threading.Lock() / RLock() /
+Condition() / Semaphore()``. ``Condition`` counts as its own lock (the
+repo's rings use it as the single slot/queue mutex).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import Finding, SourceFile
+
+RULES = {
+    "thread-safety/unguarded-attr": (
+        "attribute shared across thread groups is written without its "
+        "declared lock"
+    ),
+    "thread-safety/mixed-locks": (
+        "attribute writes are guarded by different locks at different "
+        "sites"
+    ),
+    "thread-safety/unknown-lock": (
+        "a guarded-by(<lock>) annotation names a lock the class never "
+        "declares"
+    ),
+    "thread-safety/lock-cycle": (
+        "the lock-acquisition-order graph contains a cycle (deadlock "
+        "schedule exists)"
+    ),
+}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# Containers whose methods are thread-safe under CPython; an attribute
+# assigned one of these ONCE in __init__ needs no lock for method calls.
+_SAFE_CTORS = {
+    "Event",
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+    "SimpleQueue",
+    "deque",
+    "Barrier",
+}
+
+
+def _call_ctor_name(node: ast.expr) -> Optional[str]:
+    """'Lock' for threading.Lock() / Lock(); None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_name(arg: str) -> str:
+    """Normalize a guarded-by() argument: drop an optional 'self.'
+    prefix and leading underscores so guarded-by(_lock), guarded-by(lock)
+    and guarded-by(self._lock) all name the same declared lock."""
+    name = arg.strip()
+    if name.startswith("self."):
+        name = name[len("self."):]
+    return name.lstrip("_")
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    line: int
+    write: bool
+    method: str
+    guards: Tuple[str, ...]  # locks held (lexically / via annotation)
+
+
+class _ClassInfo:
+    def __init__(self, sf: SourceFile, node: ast.ClassDef) -> None:
+        self.sf = sf
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n
+            for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.locks: Dict[str, int] = {}  # lock attr -> decl line
+        self.safe_attrs: Set[str] = set()
+        self.attr_guarded_by: Dict[str, Tuple[str, int]] = {}
+        self.attr_decl_line: Dict[str, int] = {}
+        self.accesses: List[_Access] = []
+        self.thread_entries: Set[str] = set()
+        self.calls: Dict[str, Set[str]] = {}  # method -> self-methods called
+        # method -> [(held_locks_tuple, callee or lock-acquired)]
+        self.with_edges: List[Tuple[str, str, int]] = []  # (A, B, line)
+        self.method_lock_sites: Dict[str, List[Tuple[str, int]]] = {}
+        self._scan()
+
+    # -- scanning ----------------------------------------------------------
+
+    def _method_annotation_guard(self, fn: ast.FunctionDef) -> Tuple[str, ...]:
+        """Locks declared held for the whole method via a guarded-by
+        directive on its def (or decorator) line."""
+        guards = []
+        for line in range(fn.lineno, fn.body[0].lineno):
+            for d in self.sf.directives(line, "guarded-by"):
+                if d.arg:
+                    guards.append(_lock_name(d.arg))
+        return tuple(guards)
+
+    def _scan(self) -> None:
+        for mname, fn in self.methods.items():
+            self.calls[mname] = set()
+            self.method_lock_sites[mname] = []
+            base_guards = self._method_annotation_guard(fn)
+            self._walk(fn, mname, list(base_guards), fn)
+
+    def _record_lock_decl(self, attr: str, value: ast.expr, line: int) -> None:
+        ctor = _call_ctor_name(value)
+        if ctor in _LOCK_CTORS:
+            self.locks.setdefault(attr, line)
+        elif ctor in _SAFE_CTORS:
+            self.safe_attrs.add(attr)
+
+    def _children(
+        self,
+        node: ast.AST,
+        method: str,
+        held: List[str],
+        root_fn: ast.FunctionDef,
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, method, held, root_fn)
+
+    def _walk(
+        self,
+        node: ast.AST,
+        method: str,
+        held: List[str],
+        root_fn: ast.FunctionDef,
+    ) -> None:
+        """Dispatch on `node` itself, then recurse with the lock-hold
+        context maintained."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is root_fn:
+                self._children(node, method, held, root_fn)
+            else:
+                # Nested function: runs with whatever its CALLER holds —
+                # conservatively analyze with NO held locks (a closure
+                # handed to a gauge/thread escapes the lock scope it was
+                # defined in).
+                self._children(node, method, [], root_fn)
+            return
+        if isinstance(node, ast.Lambda):
+            self._children(node, method, [], root_fn)
+            return
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in self.locks:
+                    for h in held:
+                        self.with_edges.append((h, attr, node.lineno))
+                    self.method_lock_sites[method].append(
+                        (attr, node.lineno)
+                    )
+                    acquired.append(attr)
+                else:
+                    self._walk(item.context_expr, method, held, root_fn)
+            held2 = held + acquired
+            for stmt in node.body:
+                self._walk(stmt, method, held2, root_fn)
+            return
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                self._record_target(tgt, node, method, held)
+            self._walk(node.value, method, held, root_fn)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            tgt = node.target
+            attr = _self_attr(tgt)
+            if attr is not None:
+                self._note_decl(attr, node, method)
+                self.accesses.append(
+                    _Access(attr, tgt.lineno, True, method, tuple(held))
+                )
+            if node.value is not None:
+                self._walk(node.value, method, held, root_fn)
+            return
+        if isinstance(node, ast.Call):
+            callee = _self_attr(node.func)
+            if callee is not None and callee in self.methods:
+                self.calls[method].add(callee)
+                for h in held:
+                    self.with_edges.append(
+                        (h, f"call:{callee}", node.lineno)
+                    )
+            ctor = _call_ctor_name(node)
+            if ctor == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        t = _self_attr(kw.value)
+                        if t is not None and t in self.methods:
+                            self.thread_entries.add(t)
+                        elif isinstance(kw.value, ast.Name):
+                            # A local function target still runs on a
+                            # new thread; its self-accesses were
+                            # recorded under this method — mark the
+                            # method as spawning so reachability keeps
+                            # the group.
+                            self.thread_entries.add(f"{method}:<local>")
+            self._children(node, method, held, root_fn)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                self.accesses.append(
+                    _Access(attr, node.lineno, False, method, tuple(held))
+                )
+            self._children(node, method, held, root_fn)
+            return
+        self._children(node, method, held, root_fn)
+
+    def _record_target(
+        self, tgt: ast.expr, stmt: ast.Assign, method: str, held: List[str]
+    ) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._record_target(elt, stmt, method, held)
+            return
+        attr = _self_attr(tgt)
+        if attr is None:
+            # self.x[i] = ... / self.x.y = ... mutate the OBJECT behind
+            # the attribute: count as a write of the base attribute.
+            base = tgt
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr is None:
+                return
+            self.accesses.append(
+                _Access(attr, tgt.lineno, True, method, tuple(held))
+            )
+            return
+        self._note_decl(attr, stmt, method)
+        if method == "__init__":
+            self._record_lock_decl(attr, stmt.value, stmt.lineno)
+        self.accesses.append(
+            _Access(attr, tgt.lineno, True, method, tuple(held))
+        )
+
+    def _note_decl(self, attr: str, stmt: ast.stmt, method: str) -> None:
+        if method == "__init__" and attr not in self.attr_decl_line:
+            self.attr_decl_line[attr] = stmt.lineno
+            for d in self.sf.directives(stmt.lineno, "guarded-by"):
+                if d.arg:
+                    self.attr_guarded_by[attr] = (d.arg, stmt.lineno)
+
+    # -- thread groups -----------------------------------------------------
+
+    def _reach(self, start: str) -> Set[str]:
+        seen = {start}
+        frontier = [start]
+        while frontier:
+            m = frontier.pop()
+            for callee in self.calls.get(m, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def method_groups(self) -> Dict[str, Set[str]]:
+        """method -> set of thread-group labels it may run under."""
+        entries = {e for e in self.thread_entries if ":" not in e}
+        reach = {e: self._reach(e) for e in entries}
+        groups: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        for e, methods in reach.items():
+            for m in methods:
+                if m in groups:
+                    groups[m].add(e)
+        bg_only = set().union(*reach.values()) if reach else set()
+        main_seed = [m for m in self.methods if m not in bg_only]
+        main_reach: Set[str] = set()
+        for m in main_seed:
+            main_reach |= self._reach(m)
+        for m in main_seed:
+            main_reach.add(m)
+        for m in main_reach:
+            if m in groups:
+                groups[m].add("main")
+        # Local-function thread targets: the spawning method's accesses
+        # below the spawn may still be main; the closure body was walked
+        # under the method, so give the method a synthetic bg group too.
+        for e in self.thread_entries:
+            if ":" in e:
+                m = e.split(":", 1)[0]
+                if m in groups:
+                    groups[m].add(e)
+        return groups
+
+
+def _lock_graph_for_class(info: _ClassInfo) -> List[Tuple[str, str, int]]:
+    """Directed edges (A, B, line): lock B acquired while A held.
+    Interprocedural step: an edge (A, call:m) expands to (A, L) for
+    every lock L acquired anywhere in m's self-call closure."""
+    method_locks_closure: Dict[str, Set[str]] = {}
+
+    def closure_locks(m: str, seen: Set[str]) -> Set[str]:
+        if m in method_locks_closure:
+            return method_locks_closure[m]
+        if m in seen:
+            return set()
+        seen.add(m)
+        acc = {lock for lock, _ in info.method_lock_sites.get(m, ())}
+        for callee in info.calls.get(m, ()):
+            acc |= closure_locks(callee, seen)
+        method_locks_closure[m] = acc
+        return acc
+
+    edges: List[Tuple[str, str, int]] = []
+    for a, b, line in info.with_edges:
+        if b.startswith("call:"):
+            callee = b[len("call:"):]
+            for lock in closure_locks(callee, set()):
+                edges.append((a, lock, line))
+        else:
+            edges.append((a, b, line))
+    return edges
+
+
+def build_lock_graph(
+    files: Sequence[SourceFile],
+) -> Tuple[Set[str], Dict[Tuple[str, str], Tuple[str, int]]]:
+    """(nodes, edges) across every scanned class. Nodes are
+    ``Class.lockattr``; an edge (A, B) -> (path, line) records one site
+    where B was acquired under A."""
+    nodes: Set[str] = set()
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = _ClassInfo(sf, cls)
+            for lock in info.locks:
+                nodes.add(f"{info.name}.{lock}")
+            for a, b, line in _lock_graph_for_class(info):
+                key = (f"{info.name}.{a}", f"{info.name}.{b}")
+                edges.setdefault(key, (sf.rel, line))
+    return nodes, edges
+
+
+def _find_cycles(
+    edges: Dict[Tuple[str, str], Tuple[str, int]]
+) -> List[List[str]]:
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt in on_stack:
+                i = stack.index(nxt)
+                cyc = stack[i:] + [nxt]
+                canon = tuple(sorted(cyc[:-1]))
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(cyc)
+                continue
+            if nxt in visited:
+                continue
+            visited.add(nxt)
+            stack.append(nxt)
+            on_stack.add(nxt)
+            dfs(nxt, stack, on_stack)
+            stack.pop()
+            on_stack.discard(nxt)
+
+    visited: Set[str] = set()
+    for start in sorted(adj):
+        if start in visited:
+            continue
+        visited.add(start)
+        dfs(start, [start], {start})
+    return cycles
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(_check_class(sf, cls))
+    findings.extend(_check_lock_cycles(files))
+    return findings
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    info = _ClassInfo(sf, cls)
+    if not info.thread_entries:
+        return _annotation_validity(info)
+    groups = info.method_groups()
+    out: List[Finding] = _annotation_validity(info)
+
+    by_attr: Dict[str, List[_Access]] = {}
+    for acc in info.accesses:
+        by_attr.setdefault(acc.attr, []).append(acc)
+
+    for attr, accs in sorted(by_attr.items()):
+        if attr in info.locks:
+            continue  # the locks themselves
+        ann = info.attr_guarded_by.get(attr)
+        if ann is not None and ann[0] == "gil":
+            continue  # declared bytecode-atomic; human signed off
+        writes = [a for a in accs if a.write and a.method != "__init__"]
+        if not writes:
+            continue
+        touched_groups: Set[str] = set()
+        for a in accs:
+            if a.method == "__init__":
+                # Construction happens-before Thread.start publishes the
+                # object: __init__ accesses belong to no thread group.
+                continue
+            touched_groups |= groups.get(a.method, {"main"})
+        if len(touched_groups) < 2:
+            continue  # single-thread attribute
+        if attr in info.safe_attrs and all(
+            a.method == "__init__" for a in accs if a.write
+        ):
+            continue  # thread-safe container, never rebound
+        locks_used: Set[str] = set()
+        bad: Optional[_Access] = None
+        for w in writes:
+            if not w.guards:
+                bad = w
+                break
+            locks_used.update(w.guards)
+        key = f"{sf.rel}::{info.name}.{attr}"
+        if bad is not None:
+            if sf.allows(bad.line, "thread-safety/unguarded-attr"):
+                continue
+            groups_s = ", ".join(sorted(touched_groups))
+            locks_s = (
+                ", ".join(sorted(info.locks))
+                if info.locks
+                else "<none declared>"
+            )
+            out.append(
+                Finding(
+                    rule="thread-safety/unguarded-attr",
+                    path=sf.rel,
+                    line=bad.line,
+                    message=(
+                        f"{info.name}.{attr} is shared across thread "
+                        f"groups ({groups_s}) but written in "
+                        f"{bad.method}() without a declared lock "
+                        f"(class locks: {locks_s}); hold one, or "
+                        "annotate the __init__ line with "
+                        "'# lint: guarded-by(<lock>)' / "
+                        "'# lint: guarded-by(gil)'"
+                    ),
+                    key=key,
+                )
+            )
+            continue
+        if ann is not None:
+            declared = _lock_name(ann[0])
+            actual = {_lock_name(lk) for lk in locks_used}
+            if actual - {declared}:
+                out.append(
+                    Finding(
+                        rule="thread-safety/mixed-locks",
+                        path=sf.rel,
+                        line=writes[0].line,
+                        message=(
+                            f"{info.name}.{attr} is declared guarded-by"
+                            f"({ann[0]}) but written under "
+                            f"{sorted(locks_used)}"
+                        ),
+                        key=key,
+                    )
+                )
+            continue
+        if len({_lock_name(lk) for lk in locks_used}) > 1:
+            out.append(
+                Finding(
+                    rule="thread-safety/mixed-locks",
+                    path=sf.rel,
+                    line=writes[0].line,
+                    message=(
+                        f"{info.name}.{attr} writes are guarded by "
+                        f"DIFFERENT locks {sorted(locks_used)} — pick "
+                        "one (two locks on one attribute exclude "
+                        "nobody)"
+                    ),
+                    key=key,
+                )
+            )
+    return out
+
+
+def _annotation_validity(info: _ClassInfo) -> List[Finding]:
+    """guarded-by(<lock>) must name a declared lock (or gil)."""
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for attr, (lock, line) in info.attr_guarded_by.items():
+        name = _lock_name(lock)
+        if name == "gil":
+            continue
+        if name not in {_lock_name(lk) for lk in info.locks}:
+            if (name, line) in seen:
+                continue
+            seen.add((name, line))
+            out.append(
+                Finding(
+                    rule="thread-safety/unknown-lock",
+                    path=info.sf.rel,
+                    line=line,
+                    message=(
+                        f"guarded-by({lock}) on {info.name}.{attr}: "
+                        f"{info.name} declares no lock named {lock!r} "
+                        f"(has {sorted(info.locks)})"
+                    ),
+                    key=f"{info.sf.rel}::{info.name}.{attr}:annotation",
+                )
+            )
+    return out
+
+
+def _check_lock_cycles(files: Sequence[SourceFile]) -> List[Finding]:
+    _nodes, edges = build_lock_graph(files)
+    out: List[Finding] = []
+    for cyc in _find_cycles(edges):
+        # Anchor the finding at the first edge of the cycle.
+        a, b = cyc[0], cyc[1]
+        path, line = edges.get((a, b), ("", 0))
+        order = " -> ".join(cyc)
+        out.append(
+            Finding(
+                rule="thread-safety/lock-cycle",
+                path=path,
+                line=line,
+                message=(
+                    f"lock-acquisition-order cycle: {order} (a thread "
+                    "schedule exists where each holder waits on the "
+                    "next; acquire these locks in one global order)"
+                ),
+                key=f"cycle::{'->'.join(sorted(set(cyc)))}",
+            )
+        )
+    return out

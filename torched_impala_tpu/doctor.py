@@ -13,9 +13,11 @@ Checks, in order:
    Chrome-trace export under traces/ reloaded + schema-validated),
    trajectory-ring spec checks, the resilience self-check (atomic
    checkpoint + manifest round-trip, corrupted-copy rejection,
-   config-hash resume refusal), and the serving self-check (PolicyServer
+   config-hash resume refusal), the serving self-check (PolicyServer
    + in-process clients, one batched wave vs direct agent.step parity,
-   bf16 greedy-parity gate);
+   bf16 greedy-parity gate), and the impala-lint self-check (each
+   static checker catches a seeded violation; the tree itself lints
+   clean against the baseline);
 3. per-family env contract: construct the REAL factory, reset, step a
    random policy N steps, validate the (obs, reward, terminated,
    truncated, info) surface, dtypes and shapes against the factory's
@@ -344,6 +346,87 @@ def _check_resilience() -> tuple[str, str]:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _check_lint() -> tuple[str, str]:
+    """impala-lint self-check (docs/STATIC_ANALYSIS.md): the static-
+    analysis suite must (a) catch a seeded violation of each checker —
+    a lint that silently stopped firing is worse than no lint — and
+    (b) pass over THIS tree with zero non-baselined findings, so a
+    dirty tree surfaces at doctor time exactly like a failing
+    subsystem. Purely local: AST parsing only, no jax, no threads."""
+    import os
+    import sys
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from tools.lint import run_all
+        from tools.lint.core import SourceFile
+        from tools.lint import jitb, metrics, shm, threads
+
+        seeded = {
+            "thread-safety": (
+                threads,
+                "import threading\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.n = 0\n"
+                "    def start(self):\n"
+                "        threading.Thread(target=self._loop).start()\n"
+                "    def _loop(self):\n"
+                "        self.n += 1\n"
+                "    def read(self):\n"
+                "        return self.n\n",
+            ),
+            "jit-boundary": (
+                jitb,
+                "import jax\n"
+                "@jax.jit\n"
+                "def f(x):\n"
+                "    return x.sum().item()\n",
+            ),
+            "shm-lifecycle": (
+                shm,
+                "from multiprocessing import shared_memory\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._shm = shared_memory.SharedMemory(\n"
+                "            create=True, size=8)\n",
+            ),
+            "telemetry": (
+                metrics,
+                # The seeded-violation STRING would itself trip the
+                # line-based telemetry scan — the annotation is for
+                # exactly this.
+                'reg.counter("NoSlash")\n',  # lint: allow(telemetry)
+            ),
+        }
+        for name, (mod, text) in seeded.items():
+            sf = SourceFile(f"<doctor-{name}>", f"doctor_{name}.py", text)
+            if not mod.check([sf]):
+                return "FAIL", (
+                    f"{name} checker missed its seeded violation — the "
+                    "lint has gone blind"
+                )
+        result = run_all(repo)
+        if result.findings:
+            first = result.findings[0]
+            return "FAIL", (
+                f"{len(result.findings)} non-baselined finding(s), "
+                f"first: {first.format()}"
+            )
+        return "ok", (
+            f"4 checkers catch their seeded violations; tree clean "
+            f"({len(result.suppressed)} baselined, "
+            f"{len(result.stale_baseline)} stale)"
+        )
+    except Exception:
+        return "FAIL", f"impala-lint broken:\n{traceback.format_exc()}"
+
+
 def _check_serving(seed: int = 0) -> tuple[str, str]:
     """Serving-tier self-check (docs/SERVING.md): spin up a PolicyServer
     over a fresh ParamStore, connect in-process clients, drive ONE
@@ -529,6 +612,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_serving()
     print(f"  serving    [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_lint()
+    print(f"  lint       [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

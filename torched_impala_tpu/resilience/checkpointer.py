@@ -69,7 +69,9 @@ class AsyncCheckpointer:
         # Chaos hook: called (checkpoint_path, step) after each completed
         # save — the fault-injection seam `corrupt_checkpoint` uses.
         self._post_save = post_save
-        self.error: Optional[BaseException] = None
+        # Single-writer atomic reference rebind (writer thread sets it,
+        # the learner thread only reads) — no lock by design.
+        self.error: Optional[BaseException] = None  # lint: guarded-by(gil)
 
         self._last_step = -(10**18)  # first maybe_save always fires
         self._last_time = time.monotonic()

@@ -591,7 +591,7 @@ class ProcessEnvPool:
                 self._restart(w, repr(e))
         return np.array(self._obs_block)  # copy out of the shared buffer
 
-    def step_all(
+    def step_all(  # lint: hot-loop
         self,
         actions: np.ndarray,
         out_rewards: Optional[np.ndarray] = None,

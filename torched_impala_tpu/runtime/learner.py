@@ -479,7 +479,9 @@ class Learner:
         self._batcher_thread: Optional[threading.Thread] = None
         # A batcher-thread failure is recorded here and re-raised from the
         # learner loop so a dead pipeline fails loudly instead of hanging.
-        self.error: Optional[BaseException] = None
+        # Single-writer atomic reference rebind (batcher writes, learner
+        # thread reads) — no lock by design.
+        self.error: Optional[BaseException] = None  # lint: guarded-by(gil)
         # Called on the learner thread after every SGD step with num_steps —
         # the supported place for exact-cadence side effects (interval
         # checkpointing), independent of the log_interval throttle.
@@ -1242,7 +1244,7 @@ class Learner:
             except queue.Full:
                 continue
 
-    def _batcher_loop_impl(self) -> None:
+    def _batcher_loop_impl(self) -> None:  # lint: hot-loop
         if self.traj_ring is not None:
             self._ring_batcher_loop()
             return
@@ -1288,7 +1290,7 @@ class Learner:
             ):
                 return
 
-    def _ring_batcher_loop(self) -> None:
+    def _ring_batcher_loop(self) -> None:  # lint: hot-loop
         """Trajectory-ring consumer: completed slots already ARE batches,
         so the host_stack stage collapses to a view handoff and the slot
         is device_put directly. Slots recycle only after their H2D copy
@@ -1427,7 +1429,7 @@ class Learner:
             {"version": self.num_frames},
         )
 
-    def step_once(self, timeout: Optional[float] = None) -> Mapping[str, Any]:
+    def step_once(self, timeout: Optional[float] = None) -> Mapping[str, Any]:  # lint: hot-loop
         """Block for one device batch, take one SGD step, publish params.
 
         Raises queue.Empty on timeout. Returned log values are device scalars
@@ -1484,10 +1486,15 @@ class Learner:
             )
             # _auto_jit=None stops the batcher's formats-put AND the
             # recompile path (in-flight formats-laid batches still run:
-            # the plain jit relayouts any input).
-            self._auto_jit = None
-            self._auto_compiled = None
-            self._batch_formats = None
+            # the plain jit relayouts any input). Under _auto_lock: the
+            # batcher's _ensure_auto_compiled re-checks _auto_jit inside
+            # the same lock, so a fallback landing mid-compile can never
+            # be clobbered by the compile's write-back (the race class
+            # impala-lint thread-safety/unguarded-attr polices).
+            with self._auto_lock:
+                self._auto_jit = None
+                self._auto_compiled = None
+                self._batch_formats = None
             # The failed call's donate_argnums may or may not have
             # consumed the state buffers depending on where validation
             # raised. Probe liveness before retrying: a retry on

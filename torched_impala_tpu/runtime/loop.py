@@ -27,6 +27,7 @@ from torched_impala_tpu.telemetry import (
     StallWatchdog,
     get_recorder,
     get_registry,
+    install_thread_excepthook,
 )
 
 
@@ -156,6 +157,11 @@ def train(
         raise ValueError(f"unknown actor_mode {actor_mode!r}")
     if pool_mode not in ("lockstep", "async"):
         raise ValueError(f"unknown pool_mode {pool_mode!r}")
+    # Backstop for thread bodies that (against convention) don't record
+    # their own errors: an uncaught background-thread crash lands in
+    # telemetry/runtime/thread_crashes + stderr instead of dying silently
+    # (telemetry/excepthook.py; idempotent, process-wide).
+    install_thread_excepthook()
     device = None
     if actor_device is not None:
         try:

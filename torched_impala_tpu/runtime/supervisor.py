@@ -89,7 +89,7 @@ class ActorSupervisor:
         # slot mid-restart looks dead-and-unrecoverable).
         self._lock = threading.Lock()
 
-    def _spawn_locked(self, slot: int, actor: Actor) -> None:
+    def _spawn_locked(self, slot: int, actor: Actor) -> None:  # lint: guarded-by(_lock)
         thread = threading.Thread(
             target=actor.run,
             args=(self._stop,),

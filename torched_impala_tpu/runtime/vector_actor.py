@@ -379,7 +379,7 @@ class VectorActor:
                 self._ring.abort(block)
             raise
 
-    def _unroll_lockstep_body(
+    def _unroll_lockstep_body(  # lint: hot-loop
         self, params, param_version, T, E, block, obs_buf, first_buf,
         actions, rewards, cont, logits_buf,
     ) -> List[Trajectory]:
@@ -510,7 +510,7 @@ class VectorActor:
                 self._ring.abort(block)
             raise
 
-    def _unroll_async_body(
+    def _unroll_async_body(  # lint: hot-loop
         self, params, param_version, T, E, W, Ew, wave_k, block,
         obs_buf, first_buf, actions, rewards, cont, logits_buf,
     ) -> List[Trajectory]:

@@ -306,6 +306,12 @@ class PolicyServer:
 
     def start(self) -> "PolicyServer":
         """Spawn the serving thread (idempotent)."""
+        from torched_impala_tpu.telemetry import install_thread_excepthook
+
+        # Server startup is a thread-spawning entrypoint of its own
+        # (serving runs without loop.train): arm the same process-wide
+        # crash-to-telemetry backstop before the first wave thread.
+        install_thread_excepthook()
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._serve_loop, name="policy-server", daemon=True
@@ -508,7 +514,7 @@ class PolicyServer:
             req.label = labels[req.slot]
         return taken
 
-    def _apply_resets(self, slots: Sequence[int]) -> None:
+    def _apply_resets(self, slots: Sequence[int]) -> None:  # lint: guarded-by(_service_lock)
         if not self._has_state or not slots:
             return
         idx = jnp.asarray(sorted(set(slots)), jnp.int32)
@@ -546,7 +552,7 @@ class PolicyServer:
 
         return jax.jit(_wave)
 
-    def _params_for(self, version: int, params: Any) -> Any:
+    def _params_for(self, version: int, params: Any) -> Any:  # lint: guarded-by(_service_lock)
         if self._dtype == "float32":
             return params
         cached = self._cast_cache.get(version)
@@ -568,7 +574,7 @@ class PolicyServer:
             served += self._run_label_wave(label, group)
         return served
 
-    def _run_label_wave(self, label: str, group: List[_Request]) -> int:
+    def _run_label_wave(self, label: str, group: List[_Request]) -> int:  # lint: guarded-by(_service_lock)
         B = self._max_batch
         n = len(group)
         # Resolve ONCE: every action in this wave comes from this exact
@@ -623,7 +629,7 @@ class PolicyServer:
 
     # -- shadow scoring ----------------------------------------------------
 
-    def _maybe_shadow(self, obs, first, idx, n, primary_greedy) -> None:
+    def _maybe_shadow(self, obs, first, idx, n, primary_greedy) -> None:  # lint: guarded-by(_service_lock)
         shadow_label = self._registry.shadow
         if shadow_label is None:
             return
@@ -680,7 +686,7 @@ class PolicyServer:
 
     # -- serve loop --------------------------------------------------------
 
-    def _serve_loop(self) -> None:
+    def _serve_loop(self) -> None:  # lint: hot-loop
         while True:
             with self._service_lock:
                 reqs = self._form_wave(flush=False)

@@ -279,7 +279,7 @@ class ShmRingPump:
             if not busy:
                 time.sleep(self._poll_s)
 
-    def _pump_once(self) -> bool:
+    def _pump_once(self) -> bool:  # lint: hot-loop
         """One scan: submit new REQUEST slots, write back finished cells.
         Returns True when any work happened."""
         busy = False

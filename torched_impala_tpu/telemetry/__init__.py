@@ -23,6 +23,10 @@ from torched_impala_tpu.telemetry.watchdog import (
     StallWatchdog,
     dump_thread_stacks,
 )
+from torched_impala_tpu.telemetry import excepthook as _excepthook
+
+install_thread_excepthook = _excepthook.install
+uninstall_thread_excepthook = _excepthook.uninstall
 from torched_impala_tpu.telemetry.profiling import (
     ProfilerCapture,
     StepWindowProfiler,
@@ -50,6 +54,8 @@ __all__ = [
     "set_enabled",
     "StallWatchdog",
     "dump_thread_stacks",
+    "install_thread_excepthook",
+    "uninstall_thread_excepthook",
     "ProfilerCapture",
     "StepWindowProfiler",
     "parse_profile_steps",

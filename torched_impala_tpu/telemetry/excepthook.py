@@ -1,0 +1,103 @@
+"""Process-wide threading.excepthook: background-thread crashes reach
+telemetry + stderr instead of dying silently.
+
+Every long-lived pipeline stage here runs on a daemon thread — the
+learner batcher, the async checkpoint writer, the serving wave/shadow
+loops, the supervisor monitor, the shm ring pump. Most of them catch
+their own errors and surface them through an ``error`` attribute the
+foreground re-raises, but that contract is convention, not mechanism: a
+thread body added without the try/except (the exact bug class the
+impala-lint thread-safety checker polices statically) dies with a
+stderr traceback that nothing machine-readable ever sees — a fleet run
+just loses a stage and slowly starves.
+
+This hook is the runtime backstop: any UNCAUGHT exception escaping any
+thread
+
+1. prints a tagged header + full traceback to stderr (the default hook
+   prints too, but without the telemetry pointer);
+2. increments ``telemetry/runtime/thread_crashes`` on the global
+   registry — so the crash rides the next logger snapshot merge into
+   every dashboard/JSONL stream;
+3. records a ``runtime/thread_crash`` flight-recorder instant carrying
+   the thread name and exception repr — so a post-mortem trace shows
+   WHEN the stage died relative to the batches in flight.
+
+Installed by ``loop.train`` and ``PolicyServer.start`` (idempotent);
+``uninstall()`` restores the previous hook (tests).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Optional
+
+from torched_impala_tpu.telemetry.registry import get_registry
+from torched_impala_tpu.telemetry.tracing import get_recorder
+
+_prev_hook = None
+_installed = False
+_lock = threading.Lock()
+
+
+def _hook(args) -> None:
+    if args.exc_type is SystemExit:
+        # Match the default hook's contract: SystemExit in a thread is a
+        # silent exit, not a crash.
+        return
+    name = args.thread.name if args.thread is not None else "<unknown>"
+    try:
+        print(
+            f"[thread-excepthook] uncaught {args.exc_type.__name__} in "
+            f"thread {name!r} (counted in "
+            "telemetry/runtime/thread_crashes):",
+            file=sys.stderr,
+            flush=True,
+        )
+        traceback.print_exception(
+            args.exc_type, args.exc_value, args.exc_traceback,
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+    except Exception:
+        pass  # a broken stderr must not mask the telemetry record
+    try:
+        get_registry().counter("runtime/thread_crashes").inc()
+        get_recorder().instant(
+            "runtime/thread_crash",
+            {"thread": name, "error": repr(args.exc_value)},
+        )
+    except Exception:
+        # The hook must never raise: it runs during thread teardown.
+        pass
+
+
+def install() -> None:
+    """Install the hook process-wide (idempotent). The previous hook is
+    kept for :func:`uninstall`; it is NOT chained — this hook already
+    prints the traceback the default hook would."""
+    global _prev_hook, _installed
+    with _lock:
+        if _installed:
+            return
+        _prev_hook = threading.excepthook
+        threading.excepthook = _hook
+        _installed = True
+
+
+def uninstall() -> None:
+    """Restore the hook that was active before :func:`install` (tests
+    and embedders; no-op when not installed)."""
+    global _prev_hook, _installed
+    with _lock:
+        if not _installed:
+            return
+        threading.excepthook = _prev_hook
+        _prev_hook = None
+        _installed = False
+
+
+def installed() -> bool:
+    return _installed

@@ -93,7 +93,9 @@ class StallWatchdog:
         self._stream = stream  # None = sys.stderr at dump time
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._t_start = 0.0
+        # Written once in start() BEFORE the watchdog thread exists
+        # (Thread.start is the happens-before edge); read-only after.
+        self._t_start = 0.0  # lint: guarded-by(gil)
         self._stall_active = False
         self._stalls = registry.counter("watchdog/stall")
         self.fired = threading.Event()  # latched on first stall (tests)
