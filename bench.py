@@ -287,6 +287,10 @@ def main(args) -> None:
     # acceptance: host_stack span + per-unroll enqueue copy bytes drop,
     # batches bit-identical on fixed seeds).
     section("traj_ring", lambda: run_bench_traj_ring(jax))
+    # Host-side: IMPACT replay on the ring (ISSUE 9 acceptance:
+    # max_reuse=2 gives >= 1.8x SGD updates per env frame at equal env
+    # throughput, per-update cost within a loose overhead bound).
+    section("replay", lambda: run_bench_replay(jax))
     # Host-side: resilience chaos harness (ISSUE 5 acceptance: SIGKILL'd
     # env worker + crashed actor + crashed learner -> resume reaches the
     # target step count; async checkpoint overhead < 1%).
@@ -1920,6 +1924,132 @@ def run_bench_traj_ring(jax, tiny: bool = False) -> dict:
         ),
     }
     log(f"bench: traj_ring: {out}")
+    return out
+
+
+def run_bench_replay(jax, tiny: bool = False) -> dict:
+    """IMPACT replay on the trajectory ring (ISSUE 9 tentpole): the same
+    fresh unroll stream drives two learners — replay off vs
+    ReplayConfig(max_reuse=2) — and the replay arm must deliver >= 1.8x
+    the SGD updates per env frame (each committed slot is re-delivered
+    once through the clipped-target surrogate) at equal env throughput.
+
+    Claims under test (asserted by tests/test_bench_units.py on the tiny
+    variant):
+    - `updates_per_env_frame_multiplier` >= 1.8 (the acceptance bound;
+      exactly 2.0 when nothing expires or evicts);
+    - per-update step cost stays within a loose overhead bound of the
+      plain path (`update_ms_ratio` — the surrogate adds one extra
+      target-policy unroll forward, not an extra order of magnitude);
+    - every replayed batch really went through the surrogate
+      (`replay/reuse_delivered` == n_batches).
+    """
+    import queue as queue_mod
+
+    import numpy as np
+    import optax
+
+    from torched_impala_tpu.envs.fake import ScriptedEnv
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.replay import ReplayConfig
+    from torched_impala_tpu.runtime import Learner, LearnerConfig, VectorActor
+    from torched_impala_tpu.telemetry import Registry
+
+    if tiny:
+        T, E, B, n_batches = 4, 4, 4, 3
+    else:
+        T, E, B, n_batches = 16, 8, 8, 8
+    agent = Agent(
+        ImpalaNet(num_actions=2, torso=MLPTorso(hidden_sizes=(32,)))
+    )
+
+    def measure(replay):
+        reg = Registry()  # isolated registry: per-arm telemetry deltas
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=B,
+                unroll_length=T,
+                publish_interval=1,
+                traj_ring=True,
+                replay=replay,
+                auto_layouts=False,
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+            telemetry=reg,
+        )
+        envs = [ScriptedEnv(episode_len=5) for _ in range(E)]
+        actor = VectorActor(
+            actor_id=0,
+            envs=envs,
+            agent=agent,
+            param_store=learner.param_store,
+            enqueue=learner.enqueue,
+            unroll_length=T,
+            seed=7,
+            telemetry=reg,
+            traj_ring=learner.traj_ring,
+        )
+        learner.start()
+        updates = 0
+        t0 = time.perf_counter()
+        try:
+            # Interleave pushes with steps so neither the ring nor the
+            # device queue ever backs up into a blocked actor.
+            for _ in range(n_batches):
+                for _ in range(B // E):
+                    actor.unroll_and_push()
+                try:
+                    learner.step_once(timeout=60)
+                    updates += 1
+                except queue_mod.Empty:
+                    pass
+            while True:  # drain the replay tail
+                try:
+                    learner.step_once(timeout=2.0)
+                    updates += 1
+                except queue_mod.Empty:
+                    break
+            dt = time.perf_counter() - t0
+        finally:
+            learner.stop()
+        snap = reg.snapshot()
+        env_frames = n_batches * B * T  # identical in both arms
+        entry = {
+            "updates": updates,
+            "env_frames": env_frames,
+            "updates_per_env_frame": round(updates / env_frames, 6),
+            "update_ms": round(dt * 1e3 / max(updates, 1), 3),
+            "reuse_delivered": int(
+                snap.get("telemetry/replay/reuse_delivered", 0)
+            ),
+            "target_updates": int(
+                snap.get("telemetry/replay/target_updates", 0)
+            ),
+            "evict_pressure": int(
+                snap.get("telemetry/replay/evict_pressure", 0)
+            ),
+        }
+        return entry
+
+    off = measure(None)
+    on = measure(ReplayConfig(max_reuse=2, target_update_interval=4))
+    out = {
+        "shapes": f"T={T} E={E} B={B} x {n_batches} fresh batches, MLP",
+        "off": off,
+        "on": on,
+        "updates_per_env_frame_multiplier": round(
+            on["updates_per_env_frame"]
+            / max(off["updates_per_env_frame"], 1e-12),
+            3,
+        ),
+        "update_ms_ratio": round(
+            on["update_ms"] / max(off["update_ms"], 1e-9), 3
+        ),
+    }
+    log(f"bench: replay: {out}")
     return out
 
 

@@ -11,5 +11,6 @@ z = "telemetry/ok/key"
 bad_literal = "telemetry/0bad"  # literal-key (leading digit component)
 reg.counter("resilience/orphan_series")  # subfamily-prefix  # noqa: F821
 reg.counter("serving/orphan_series")  # subfamily-prefix  # noqa: F821
+reg.counter("replay/orphan_series")  # subfamily-prefix (rule 3d)  # noqa: F821
 rec.instant("Bad.Trace")  # trace-grammar  # noqa: F821
 rec.complete("serving/rogue_event", 0, 1)  # trace-closed-set  # noqa: F821

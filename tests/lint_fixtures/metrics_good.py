@@ -7,6 +7,8 @@ reg.timer("learner/train_step")  # noqa: F821
 reg.span("learner/train_step")  # span == timer: same series, no fork  # noqa: F821
 reg.counter("resilience/checkpoint_bytes")  # pinned sub-family  # noqa: F821
 reg.counter("serving/request_total")  # pinned sub-family  # noqa: F821
+reg.counter("replay/reuse_delivered")  # pinned sub-family (3d)  # noqa: F821
+reg.gauge("replay/target_lag")  # pinned sub-family (3d)  # noqa: F821
 key = "telemetry/pool/restarts"
 rec.instant("ring/commit", {"lid": "a0u0"})  # noqa: F821
 rec.complete("serving/request", 0, 1)  # pinned trace set  # noqa: F821

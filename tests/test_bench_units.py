@@ -99,6 +99,33 @@ def test_traj_ring_bench_overhead_bound(jax_cpu):
     assert r["host_stack_ms"] < q["host_stack_ms"], out
 
 
+def test_replay_bench_multiplies_updates_per_env_frame(jax_cpu):
+    """The ISSUE 9 acceptance bound, wired into CI via the bench replay
+    section's tiny variant: with max_reuse=2 on the same fresh unroll
+    stream the learner must take >= 1.8x the SGD updates per env frame
+    (exactly 2.0 when nothing evicts or expires — the 1.8 floor keeps
+    slack for an eviction under scheduling pressure), every replayed
+    batch must really have gone through the surrogate path, and the
+    per-update wall cost must stay within a loose overhead bound (6x —
+    the tiny run is compile-dominated, so this is a sanity ceiling, not
+    a perf claim; steady-state cost is one extra target-policy unroll
+    forward)."""
+    from bench import run_bench_replay
+
+    out = run_bench_replay(jax_cpu, tiny=True)
+    assert out["updates_per_env_frame_multiplier"] >= 1.8, out
+    on, off = out["on"], out["off"]
+    # Equal env throughput by construction; the extra updates are real
+    # replay deliveries, each a surrogate train step with a live target.
+    assert on["env_frames"] == off["env_frames"], out
+    assert on["reuse_delivered"] >= 2, out
+    assert on["updates"] == off["updates"] + on["reuse_delivered"], out
+    assert on["target_updates"] >= 1, out
+    # The plain arm must not silently grow replay series.
+    assert off["reuse_delivered"] == 0 and off["target_updates"] == 0, out
+    assert out["update_ms_ratio"] <= 6.0, out
+
+
 def test_chaos_bench_recovers_with_bounded_overhead(jax_cpu):
     """The ISSUE 5 acceptance bound, wired into CI via the bench chaos
     section's tiny variant: with a fault plan that SIGKILLs one env

@@ -300,6 +300,41 @@ class TestCLI:
         cfg = build_config(args)
         assert cfg.num_actions == 2
 
+    def test_replay_flags_reach_learner_config(self):
+        """The five replay flags override the preset and materialize as
+        a validated ReplayConfig on the LearnerConfig; without them the
+        learner config carries replay=None (the structural-parity path,
+        docs/REPLAY.md)."""
+        from torched_impala_tpu.configs import make_learner_config
+        from torched_impala_tpu.run import build_config, parse_args
+
+        args = parse_args(
+            [
+                "--config", "cartpole",
+                "--traj-ring",
+                "--max-reuse", "3",
+                "--replay-mix", "0.5",
+                "--replay-staleness-frames", "640",
+                "--target-update-interval", "16",
+                "--target-clip-epsilon", "0.3",
+            ]
+        )
+        cfg = build_config(args)
+        assert cfg.max_reuse == 3 and cfg.traj_ring
+        lc = make_learner_config(cfg)
+        rp = lc.replay
+        assert rp is not None and rp.enabled
+        assert (rp.max_reuse, rp.replay_mix) == (3, 0.5)
+        assert rp.staleness_frames == 640
+        assert rp.target_update_interval == 16
+        assert rp.target_clip_epsilon == 0.3
+        rp.validate()
+
+        plain = make_learner_config(
+            build_config(parse_args(["--config", "cartpole"]))
+        )
+        assert plain.replay is None
+
     def test_probe_num_actions_reads_real_env(self):
         from torched_impala_tpu import configs
 

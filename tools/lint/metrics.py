@@ -17,8 +17,8 @@ Rules (rule ids in parentheses):
 3. literal emitted keys (``"telemetry/..."`` strings,
    ``f"{PREFIX}/..."`` interpolations) carry the same grammar
    (``telemetry/literal-key``);
-3b/3c. ``resilience/*`` and ``serving/*`` names use their pinned
-   sub-family prefixes (``telemetry/subfamily-prefix``);
+3b/3c/3d. ``resilience/*``, ``serving/*`` and ``replay/*`` names use
+   their pinned sub-family prefixes (``telemetry/subfamily-prefix``);
 4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
    ``.complete`` — follow the same slug grammar
    (``telemetry/trace-grammar``);
@@ -44,8 +44,8 @@ RULES = {
     "telemetry/type-fork": "one metric name registered as two types",
     "telemetry/literal-key": "literal emitted key violates the grammar",
     "telemetry/subfamily-prefix": (
-        "resilience/* or serving/* name lacks its pinned sub-family "
-        "prefix"
+        "resilience/*, serving/* or replay/* name lacks its pinned "
+        "sub-family prefix"
     ),
     "telemetry/trace-grammar": "trace event name violates the grammar",
     "telemetry/trace-closed-set": (
@@ -72,6 +72,10 @@ RESILIENCE_PREFIXES = ("checkpoint_", "supervisor_", "chaos_", "recovery_")
 SERVING_PREFIXES = (
     "request_", "wave_", "shadow_", "client_", "version_", "ring_",
 )
+# Rule 3d (replay subsystem, ISSUE 9): the replay/* family is pinned to
+# the four sub-families docs/OBSERVABILITY.md documents — reuse
+# accounting, target-store health, eviction pressure, staleness.
+REPLAY_PREFIXES = ("reuse_", "target_", "evict_", "staleness_")
 SERVING_TRACE_EVENTS = {
     "serving/request", "serving/wave", "serving/shadow",
 }
@@ -137,6 +141,16 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         name,
                         f"serving metric {name!r} must use a "
                         f"sub-family prefix {SERVING_PREFIXES}",
+                    )
+                    continue
+                if name.startswith("replay/") and not name.split(
+                    "/", 1
+                )[1].startswith(REPLAY_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"replay metric {name!r} must use a "
+                        f"sub-family prefix {REPLAY_PREFIXES}",
                     )
                     continue
                 prev = seen.get(name)

@@ -126,6 +126,17 @@ class ExperimentConfig:
     # write. Opt-in; needs vectorized actors whose env counts divide
     # batch_size and the single-device K=1 learner (LearnerConfig docs).
     traj_ring: bool = False
+    # IMPACT replay (torched_impala_tpu/replay/, docs/REPLAY.md): train
+    # on each ring slot up to `max_reuse` times with the clipped
+    # target-network surrogate. max_reuse > 1 requires traj_ring=True
+    # and target_update_interval >= 1 (ReplayConfig.validate); the
+    # defaults keep replay off (and the learner on the exact pre-replay
+    # code path).
+    max_reuse: int = 1
+    replay_mix: float = 1.0
+    replay_staleness_frames: int = 0
+    target_update_interval: int = 0
+    target_clip_epsilon: float = 0.2
     unroll_length: int = 20
     batch_size: int = 8
     # Fuse K SGD steps into one dispatched XLA program (lax.scan over a
@@ -319,6 +330,17 @@ def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
 
 
 def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
+    replay = None
+    if cfg.max_reuse > 1 or cfg.target_update_interval > 0:
+        from torched_impala_tpu.replay import ReplayConfig
+
+        replay = ReplayConfig(
+            max_reuse=cfg.max_reuse,
+            replay_mix=cfg.replay_mix,
+            staleness_frames=cfg.replay_staleness_frames,
+            target_update_interval=cfg.target_update_interval,
+            target_clip_epsilon=cfg.target_clip_epsilon,
+        )
     return LearnerConfig(
         batch_size=cfg.batch_size,
         unroll_length=cfg.unroll_length,
@@ -331,6 +353,7 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
         max_grad_norm=cfg.max_grad_norm,
         steps_per_dispatch=cfg.steps_per_dispatch,
         traj_ring=cfg.traj_ring,
+        replay=replay,
         popart=(
             PopArtConfig(
                 num_values=cfg.num_tasks, step_size=cfg.popart_step_size

@@ -21,6 +21,7 @@ from typing import Mapping, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from torched_impala_tpu.ops.vtrace import clipped_surrogate as _clipped_surrogate
 from torched_impala_tpu.ops.vtrace import vtrace as _vtrace
 
 
@@ -211,5 +212,109 @@ def impala_loss(
         extra_logs={
             "mean_vtrace_target": jnp.mean(vt.vs),
             "mean_advantage": jnp.mean(vt.pg_advantages),
+        },
+    )
+
+
+def impact_loss(
+    *,
+    learner_logits: jax.Array,
+    target_logits: jax.Array,
+    behaviour_logits: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    actions: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    mask: jax.Array | None = None,
+    clip_epsilon: float = 0.2,
+    config: ImpalaLossConfig = ImpalaLossConfig(),
+    devices=None,
+) -> LossOutput:
+    """IMPACT clipped-target surrogate loss (arXiv:1912.00167), time-major.
+
+    The replay-safe sibling of `impala_loss` (replay/ subsystem,
+    docs/REPLAY.md "Loss math"). Three policies are in play:
+
+      mu        — behaviour policy (actor logits recorded at act time)
+      pi_target — the pinned target network (replay.TargetParamStore),
+                  STALE BY CONSTRUCTION and held constant
+      pi_theta  — the live learner policy being optimized
+
+    V-trace corrections (rho, c, and the pg advantage) use
+    pi_target / mu — the target policy is the stable anchor the replayed
+    data is corrected towards — while the optimized term is the
+    PPO-style clipped surrogate on r = pi_theta / pi_target
+    (`ops.vtrace.clipped_surrogate`), so a slot replayed `reuse_count`
+    times cannot drag pi_theta more than ~epsilon per step from the
+    anchor regardless of how stale it has become.
+
+    The baseline and entropy terms mirror `impala_loss` exactly: the
+    baseline regresses the LIVE values onto the target-policy V-trace
+    targets; entropy is of the live learner policy.
+
+    Note this is deliberately NOT a generalization of `impala_loss`:
+    at clip_epsilon→inf and target==learner the surrogate's VALUE is
+    sum(A_t) rather than sum(-A_t log pi) (the gradients coincide at
+    r=1, the objectives don't), so the replay-disabled learner takes
+    the `impala_loss` code path unchanged — bit-identity by structure,
+    pinned by tests/test_replay.py.
+
+    Args:
+      learner_logits: `[T, B, A]` live-policy logits — carry gradient.
+      target_logits: `[T, B, A]` pinned-target logits — stop-gradiented
+        here (belt and braces: the learner also stops them at unroll).
+      behaviour_logits: `[T, B, A]` actor logits recorded at act time.
+      values, bootstrap_value: live baseline V(x_t) `[T, B]` / V(x_T) `[B]`.
+      actions, rewards, discounts, mask: as in `impala_loss`.
+      clip_epsilon: surrogate clip radius (ReplayConfig.target_clip_epsilon).
+      config, devices: as in `impala_loss`.
+
+    Returns:
+      LossOutput whose logs add `impact_ratio` (mean learner/target
+      ratio, drift gauge) and `impact_clip_frac` (fraction of valid
+      steps where the clip is active) to the standard set.
+    """
+    if mask is None:
+        mask = jnp.ones_like(rewards)
+    mask = mask.astype(values.dtype)
+
+    target_logits = jax.lax.stop_gradient(target_logits)
+    target_lp = action_log_probs(target_logits, actions)
+    log_rhos = target_lp - action_log_probs(behaviour_logits, actions)
+    vt = _vtrace(
+        log_rhos=log_rhos,
+        discounts=discounts,
+        rewards=rewards,
+        values=jax.lax.stop_gradient(values),
+        bootstrap_value=jax.lax.stop_gradient(bootstrap_value),
+        clip_rho_threshold=config.clip_rho_threshold,
+        clip_c_threshold=config.clip_c_threshold,
+        clip_pg_rho_threshold=config.clip_pg_rho_threshold,
+        lambda_=config.lambda_,
+        implementation=config.vtrace_implementation,
+        devices=devices,
+    )
+
+    log_ratio = action_log_probs(learner_logits, actions) - target_lp
+    surrogate, ratio = _clipped_surrogate(
+        log_ratio, vt.pg_advantages, clip_epsilon
+    )
+    pg = _reduce(-surrogate, mask, config.reduction)
+    bl = baseline_loss(vt.vs - values, mask, config.reduction)
+    ent = entropy_loss(learner_logits, mask, config.reduction)
+    n_valid = jnp.maximum(jnp.sum(mask), 1.0)
+    clipped = jnp.abs(ratio - 1.0) > clip_epsilon
+    return assemble_loss(
+        pg=pg,
+        bl=bl,
+        ent=ent,
+        mask=mask,
+        config=config,
+        extra_logs={
+            "mean_vtrace_target": jnp.mean(vt.vs),
+            "mean_advantage": jnp.mean(vt.pg_advantages),
+            "impact_ratio": jnp.sum(ratio * mask) / n_valid,
+            "impact_clip_frac": jnp.sum(clipped * mask) / n_valid,
         },
     )

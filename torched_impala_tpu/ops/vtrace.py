@@ -104,6 +104,32 @@ def importance_ratios(
     return jnp.exp(target_log_probs - behaviour_log_probs)
 
 
+def clipped_surrogate(
+    log_ratio: jax.Array, advantages: jax.Array, clip_epsilon: float
+) -> tuple[jax.Array, jax.Array]:
+    """PPO-style clipped surrogate term, the IMPACT objective's core
+    (arXiv:1912.00167 eq. 2; consumed by `ops.losses.impact_loss`).
+
+        surrogate_t = min(r_t * A_t, clip(r_t, 1-eps, 1+eps) * A_t)
+        r_t = pi_learner(a_t|x_t) / pi_target(a_t|x_t)
+
+    Args:
+      log_ratio: `[T, B]` log(pi_learner / pi_target) of taken actions —
+        must carry gradient through the learner log-probs.
+      advantages: `[T, B]` V-trace pg advantages (stop-gradiented here;
+        they are targets, not a gradient path).
+      clip_epsilon: the clip radius around r = 1.
+
+    Returns:
+      (surrogate, ratio), both `[T, B]`. Maximize the surrogate (the loss
+      negates it). `ratio` is returned for clip-fraction telemetry.
+    """
+    advantages = jax.lax.stop_gradient(advantages)
+    ratio = jnp.exp(log_ratio)
+    clipped = jnp.clip(ratio, 1.0 - clip_epsilon, 1.0 + clip_epsilon)
+    return jnp.minimum(ratio * advantages, clipped * advantages), ratio
+
+
 def vtrace_scan(
     *,
     log_rhos: jax.Array,

@@ -1,0 +1,30 @@
+"""IMPACT-style circular replay (arxiv 1912.00167; ROADMAP sample-reuse
+item): the training-side machinery that lets the learner consume each
+trajectory-ring slot more than once without off-policy collapse.
+
+Three pillars, each owned by a different layer:
+
+- ring replay — `runtime/traj_ring.py` grows a retain-after-release
+  mode (``max_reuse`` / ``replay_mix`` / ``staleness_frames``): released
+  slots park on a retained list and a seeded, fresh-first sampler
+  re-delivers them until their reuse budget or staleness bound expires;
+- target network — :class:`TargetParamStore` (replay/target_store.py)
+  pins a hard on-device copy of the learner params every
+  ``target_update_interval`` steps, the π_target of the clipped
+  surrogate;
+- clipped-target surrogate loss — ``ops.losses.impact_loss`` computes
+  V-trace corrections against the target policy and clips the
+  learner/target ratio PPO-style, so replayed (2-staleness-steps-old)
+  data cannot drag the update off-policy.
+
+:class:`ReplayConfig` (replay/config.py) is the single knob surface;
+``LearnerConfig.replay`` threads it through the runtime. The
+``replay/*`` telemetry key space (docs/OBSERVABILITY.md) is pinned to
+the ``reuse_`` / ``target_`` / ``evict_`` / ``staleness_`` sub-family
+prefixes by lint rule 3d (tools/lint/metrics.py).
+"""
+
+from torched_impala_tpu.replay.config import ReplayConfig
+from torched_impala_tpu.replay.target_store import TargetParamStore
+
+__all__ = ["ReplayConfig", "TargetParamStore"]

@@ -1,0 +1,90 @@
+"""Replay subsystem knobs (docs/REPLAY.md tuning guide).
+
+One frozen dataclass so the whole IMPACT surface — ring retention,
+sampling, target-network cadence, surrogate clipping — travels together
+through ``LearnerConfig.replay`` and stays hashable for jit statics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayConfig:
+    """IMPACT-style circular replay (arxiv 1912.00167).
+
+    ``max_reuse=1`` with ``target_update_interval=0`` is the disabled
+    configuration: the learner takes the EXACT pre-replay code path
+    (bit-identical losses, pinned by tests/test_replay.py parity test).
+    """
+
+    # Deliveries per committed ring slot (1 = train-once, today's
+    # behavior). >1 turns the trajectory ring into a circular replay
+    # buffer and REQUIRES a target network (target_update_interval >= 1):
+    # replayed data is off-policy by construction and the plain V-trace
+    # learner path has no clipping against the drift.
+    max_reuse: int = 1
+    # Max fraction of delivered batches that may be replays (fresh
+    # batches always win when ready — the sampler is fresh-first; this
+    # caps how far replays can run ahead when actors stall). 1.0 leaves
+    # the reuse budget as the only bound.
+    replay_mix: float = 1.0
+    # Expire a retained slot once the learner's frame counter has moved
+    # more than this many frames past the slot's acting param version
+    # (0 = no staleness bound; the reuse budget still applies). The
+    # ring checks it at every version note, sample, and release.
+    staleness_frames: int = 0
+    # Learner steps between target-network refreshes (hard on-device
+    # copy, no host sync — replay/target_store.py). 0 = no target
+    # network (only legal while max_reuse == 1).
+    target_update_interval: int = 0
+    # PPO-style clip on the learner/target importance ratio in the
+    # surrogate objective (ops.losses.impact_loss); IMPACT's epsilon.
+    target_clip_epsilon: float = 0.2
+    # Refuse to serve a target older than this many frames behind the
+    # newest version the learner reported (0 = never refuse). The
+    # doctor's replay self-check pins the refusal path.
+    target_max_lag_frames: int = 0
+    # Seed of the ring's replay sampler (np.random.default_rng) — the
+    # staleness-weighted draw among retained slots is deterministic
+    # given the seed and the delivery order.
+    sampler_seed: int = 0
+
+    @property
+    def enabled(self) -> bool:
+        """True when this config changes the learner's behavior at all."""
+        return self.max_reuse > 1 or self.target_update_interval > 0
+
+    def validate(self) -> None:
+        if self.max_reuse < 1:
+            raise ValueError(f"max_reuse must be >= 1, got {self.max_reuse}")
+        if not (0.0 < self.replay_mix <= 1.0):
+            raise ValueError(
+                f"replay_mix must be in (0, 1], got {self.replay_mix}"
+            )
+        if self.staleness_frames < 0:
+            raise ValueError(
+                f"staleness_frames must be >= 0, got {self.staleness_frames}"
+            )
+        if self.target_update_interval < 0:
+            raise ValueError(
+                f"target_update_interval must be >= 0, got "
+                f"{self.target_update_interval}"
+            )
+        if self.max_reuse > 1 and self.target_update_interval < 1:
+            raise ValueError(
+                "max_reuse > 1 replays off-policy data and requires the "
+                "clipped target-network surrogate: set "
+                "target_update_interval >= 1 (IMPACT, arxiv 1912.00167)"
+            )
+        if not (0.0 < self.target_clip_epsilon < 1.0):
+            raise ValueError(
+                f"target_clip_epsilon must be in (0, 1), got "
+                f"{self.target_clip_epsilon}"
+            )
+        if self.target_max_lag_frames < 0:
+            raise ValueError(
+                f"target_max_lag_frames must be >= 0, got "
+                f"{self.target_max_lag_frames}"
+            )

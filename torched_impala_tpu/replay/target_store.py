@@ -1,0 +1,134 @@
+"""Target-network parameter pinning for IMPACT replay.
+
+`TargetParamStore` wraps the learner's :class:`ParamStore` with the one
+capability replay needs and the store deliberately lacks: a HARD
+on-device copy of the params, refreshed every ``update_interval``
+learner steps. The wrapped store's keep-last-K ring retains HOST
+snapshots for actors and serving pins; the target must instead stay on
+the compute device (the surrogate loss consumes it every step — a host
+round trip per step would serialize D2H+H2D onto the critical path),
+and it must be a COPY, because the train step donates the live param
+buffers and a shared reference would dangle after the next update.
+
+Telemetry (docs/OBSERVABILITY.md "replay" rows): ``replay/target_lag``
+(frames between the newest version the learner reported and the pinned
+target) and ``replay/target_updates`` (refresh count). Staleness
+refusal: with ``max_lag_frames > 0``, `current()` raises rather than
+serve a target beyond the bound — the doctor's replay self-check pins
+this path, and it is the backstop against a mis-wired cadence silently
+training against an ancient policy.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
+
+if TYPE_CHECKING:
+    # Import-time would be circular: runtime/__init__ imports the
+    # learner, which imports this package.
+    from torched_impala_tpu.runtime.param_store import ParamStore
+
+
+class TargetParamStore:
+    """Pins π_target for the clipped surrogate (replay/__init__.py).
+
+    Single-writer contract: `update` / `maybe_update` run on the learner
+    thread only (same thread that owns the live params), so the pinned
+    tree is rebound atomically and readers on the same thread never see
+    a torn (version, params) pair.
+    """
+
+    def __init__(
+        self,
+        store: "ParamStore",
+        *,
+        update_interval: int,
+        max_lag_frames: int = 0,
+        telemetry: Optional[Registry] = None,
+    ) -> None:
+        if update_interval < 1:
+            raise ValueError(
+                f"update_interval must be >= 1, got {update_interval}"
+            )
+        if max_lag_frames < 0:
+            raise ValueError(
+                f"max_lag_frames must be >= 0, got {max_lag_frames}"
+            )
+        self._store = store
+        self.update_interval = int(update_interval)
+        self.max_lag_frames = int(max_lag_frames)
+        self._target: Any = None
+        self._target_version = -1
+        self._last_update_step: Optional[int] = None
+        # Newest version the learner has reported (via update/
+        # maybe_update); the store's published version can trail it
+        # under publish_interval > 1, so lag is measured against the
+        # max of the two.
+        self._latest_version = -1
+        reg = telemetry if telemetry is not None else get_registry()
+        self._m_lag = reg.gauge("replay/target_lag")
+        self._m_updates = reg.counter("replay/target_updates")
+
+    def update(self, params: Any, *, version: int, step: int) -> None:
+        """Pin `params` as the target: ON-DEVICE copies (`jnp.copy`
+        dispatches without a host sync), never shared references — the
+        train step donates the live buffers."""
+        self._target = jax.tree.map(jnp.copy, params)
+        self._target_version = int(version)
+        self._latest_version = max(self._latest_version, int(version))
+        self._last_update_step = int(step)
+        self._m_updates.inc()
+        self._m_lag.set(self.lag())
+
+    def maybe_update(self, step: int, params: Any, version: int) -> bool:
+        """Refresh when `update_interval` steps have elapsed since the
+        last pin (learner thread, once per step). Always advances the
+        newest-version watermark so the lag gauge (and the staleness
+        refusal) track reality between refreshes."""
+        self._latest_version = max(self._latest_version, int(version))
+        if (
+            self._last_update_step is None
+            or step - self._last_update_step >= self.update_interval
+        ):
+            self.update(params, version=version, step=step)
+            return True
+        self._m_lag.set(self.lag())
+        return False
+
+    def lag(self) -> int:
+        """Frames between the newest known version and the pinned target."""
+        newest = max(self._latest_version, self._store.version)
+        return max(0, newest - self._target_version)
+
+    @property
+    def version(self) -> int:
+        return self._target_version
+
+    def current(self) -> Tuple[int, Any]:
+        """(version, on-device params) of the pinned target.
+
+        Raises RuntimeError before the first `update`, or — with
+        ``max_lag_frames`` set — when the target has fallen beyond the
+        staleness bound (a mis-wired refresh cadence must fail loudly,
+        not train against an ancient policy)."""
+        if self._target is None:
+            raise RuntimeError(
+                "TargetParamStore.current() before the first update(); "
+                "pin the initial params at learner construction"
+            )
+        lag = self.lag()
+        self._m_lag.set(lag)
+        if self.max_lag_frames > 0 and lag > self.max_lag_frames:
+            raise RuntimeError(
+                f"target params are {lag} frames stale (version "
+                f"{self._target_version} vs newest "
+                f"{max(self._latest_version, self._store.version)}), "
+                f"beyond max_lag_frames={self.max_lag_frames}; the "
+                f"update cadence is mis-wired"
+            )
+        return self._target_version, self._target

@@ -66,6 +66,29 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "needs vectorized actors whose env counts divide "
                         "batch-size and the single-device K=1 learner "
                         "(runtime/traj_ring.py)")
+    p.add_argument("--max-reuse", type=int, default=None,
+                   help="replay: deliver each committed unroll up to N "
+                        "times from the trajectory ring before recycling "
+                        "its slot (IMPACT-style circular replay; needs "
+                        "--traj-ring and --target-update-interval; "
+                        "torched_impala_tpu/replay/, docs/REPLAY.md)")
+    p.add_argument("--replay-mix", type=float, default=None,
+                   help="replay: cap on the replayed fraction of delivered "
+                        "batches (0 < f <= 1; fresh batches always take "
+                        "priority regardless)")
+    p.add_argument("--replay-staleness-frames", type=int, default=None,
+                   help="replay: expire retained unrolls once the learner "
+                        "frame watermark moves more than N frames past "
+                        "their oldest transition (0 = no bound)")
+    p.add_argument("--target-update-interval", type=int, default=None,
+                   help="replay: refresh the on-device target-policy "
+                        "snapshot every N learner steps (the clipped "
+                        "surrogate anchors to it; required when "
+                        "--max-reuse > 1)")
+    p.add_argument("--target-clip-epsilon", type=float, default=None,
+                   help="replay: PPO-style clip radius for the "
+                        "learner/target policy ratio in the surrogate "
+                        "loss (default 0.2)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--unroll-length", type=int, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=None,
@@ -240,6 +263,11 @@ def build_config(args: argparse.Namespace):
         ("actor_mode", "actor_mode"),
         ("pool_mode", "pool_mode"),
         ("pool_ready_fraction", "pool_ready_fraction"),
+        ("max_reuse", "max_reuse"),
+        ("replay_mix", "replay_mix"),
+        ("replay_staleness_frames", "replay_staleness_frames"),
+        ("target_update_interval", "target_update_interval"),
+        ("target_clip_epsilon", "target_clip_epsilon"),
         ("batch_size", "batch_size"),
         ("unroll_length", "unroll_length"),
         ("steps_per_dispatch", "steps_per_dispatch"),

@@ -39,6 +39,7 @@ from torched_impala_tpu.ops.losses import (
     SUM_REDUCED_LOG_KEYS,
     ImpalaLossConfig,
     impala_loss,
+    impact_loss,
 )
 from torched_impala_tpu.ops.popart import PopArtConfig
 from torched_impala_tpu.parallel.mesh import (
@@ -49,6 +50,7 @@ from torched_impala_tpu.parallel.mesh import (
     state_sharding,
 )
 from torched_impala_tpu.parallel import multihost
+from torched_impala_tpu.replay import ReplayConfig, TargetParamStore
 from torched_impala_tpu.runtime.param_store import ParamStore
 from torched_impala_tpu.runtime.traj_ring import TrajectoryRing
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
@@ -156,6 +158,16 @@ class LearnerConfig:
     # compute device is NOT supported (the train step would pull every
     # batch cross-backend); None = default device.
     data_device: Optional[str] = None
+    # IMPACT-style replay (replay/ subsystem, docs/REPLAY.md): retain
+    # ring slots for up to max_reuse deliveries and train replayed
+    # batches with the clipped target-network surrogate
+    # (ops.losses.impact_loss) against a TargetParamStore pinned every
+    # target_update_interval steps. None — or a disabled ReplayConfig
+    # (max_reuse=1, target_update_interval=0) — keeps the EXACT
+    # pre-replay code path (bit-parity, tests/test_replay.py). Enabled
+    # replay requires traj_ring (the ring IS the replay buffer) and is
+    # single-device / no-PopArt / grad_accum=1 for now.
+    replay: Optional[ReplayConfig] = None
 
 
 class BatchLineage(NamedTuple):
@@ -164,11 +176,17 @@ class BatchLineage(NamedTuple):
     the consumed unrolls' flight-recorder IDs (column order), `versions`
     their param versions — the inputs of the EXACT per-batch staleness
     the train-step trace span reports (the `learner/param_lag_frames`
-    gauge is the min-version summary of the same numbers)."""
+    gauge is the min-version summary of the same numbers). Replay mode
+    adds `reuse_count` (which delivery of the slot's contents this batch
+    is; 1 = fresh) and `staleness` (frame delta to the learner watermark
+    at delivery) so the train-step trace span distinguishes replayed
+    from fresh consumption."""
 
     batch: int
     lineage: tuple = ()
     versions: tuple = ()
+    reuse_count: int = 1
+    staleness: int = 0
 
 
 def _put_format(x, fmt):
@@ -536,10 +554,40 @@ class Learner:
 
         reg.gauge("queue/depth", fn=_depth)
 
+        # IMPACT replay (replay/ subsystem): validated BEFORE the ring is
+        # built — an enabled config changes the ring's slot count and
+        # retention mode. A disabled ReplayConfig normalizes to None so
+        # every later `self._replay is None` check IS the bit-parity
+        # switch (tests/test_replay.py).
+        rp = config.replay
+        if rp is not None:
+            rp.validate()
+        self._replay: Optional[ReplayConfig] = (
+            rp if rp is not None and rp.enabled else None
+        )
+        if self._replay is not None:
+            if not config.traj_ring:
+                raise ValueError(
+                    "replay requires traj_ring=True: the trajectory ring "
+                    "IS the circular replay buffer (docs/REPLAY.md)"
+                )
+            if config.popart is not None:
+                raise ValueError(
+                    "replay does not compose with PopArt yet (the "
+                    "clipped-target surrogate path has no per-task "
+                    "rescaling)"
+                )
+            if config.grad_accum != 1:
+                raise ValueError(
+                    "replay requires grad_accum=1 (the surrogate step "
+                    "has no microbatch scan)"
+                )
+
         # Zero-copy trajectory ring (LearnerConfig.traj_ring): slots are
         # complete [T+1, B, ...] batches actors write in place. Sized so
         # the device queue can hold its depth in transferred slots while
-        # one slot fills and one spare absorbs jitter.
+        # one slot fills and one spare absorbs jitter; replay-with-reuse
+        # adds two more so retained slots don't starve the free list.
         self.traj_ring: Optional[TrajectoryRing] = None
         if config.traj_ring:
             if mesh is not None:
@@ -557,8 +605,13 @@ class Learner:
                     "traj_ring requires steps_per_dispatch=1 (the "
                     "[K, ...] superbatch keeps the queue path)"
                 )
+            replaying = (
+                self._replay is not None and self._replay.max_reuse > 1
+            )
             self.traj_ring = TrajectoryRing(
-                num_slots=config.device_queue_depth + 2,
+                num_slots=config.device_queue_depth
+                + 2
+                + (2 if replaying else 0),
                 unroll_length=config.unroll_length,
                 batch_size=self._local_batch_size,
                 example_obs=np.asarray(example_obs),
@@ -566,10 +619,32 @@ class Learner:
                 agent_state_example=agent.initial_state(1),
                 telemetry=reg,
                 tracer=self._tracer,
+                max_reuse=self._replay.max_reuse if replaying else 1,
+                replay_mix=self._replay.replay_mix if replaying else 1.0,
+                staleness_frames=(
+                    self._replay.staleness_frames if replaying else 0
+                ),
+                sampler_seed=(
+                    self._replay.sampler_seed if replaying else 0
+                ),
             )
 
         self.param_store = ParamStore()
         self._publish()
+
+        # Target network (replay/target_store.py): pinned on-device copy
+        # of the params the surrogate clips against, refreshed every
+        # target_update_interval steps from step_once. Initialized from
+        # the just-published init params so step 1 has a target.
+        self._target_store: Optional[TargetParamStore] = None
+        if self._replay is not None:
+            self._target_store = TargetParamStore(
+                self.param_store,
+                update_interval=self._replay.target_update_interval,
+                max_lag_frames=self._replay.target_max_lag_frames,
+                telemetry=reg,
+            )
+            self._target_store.update(self._params, version=0, step=0)
 
         if config.steps_per_dispatch < 1:
             raise ValueError(
@@ -601,9 +676,23 @@ class Learner:
         self._batch_formats = None
         self._auto_lock = threading.Lock()
         self._auto_jit = None
+        # Replay step: a SEPARATE jit program taking the target params
+        # as a fourth (non-donated — reused across steps) state arg.
+        # auto_layouts stays off under replay: the AOT machinery
+        # compiles the standard step's formats, which the replay
+        # program would then refuse.
+        self._replay_step = None
         if mesh is None:
             self._train_step = jax.jit(step_impl, donate_argnums=(0, 1, 2))
-            if config.auto_layouts and config.data_device is None:
+            if self._replay is not None:
+                self._replay_step = jax.jit(
+                    self._train_step_replay_impl, donate_argnums=(0, 1, 2)
+                )
+            if (
+                config.auto_layouts
+                and config.data_device is None
+                and self._replay is None
+            ):
                 auto = _auto_format()
                 if auto is not None:  # jax without AUTO layouts: plain jit
                     self._auto_jit = jax.jit(
@@ -855,6 +944,73 @@ class Learner:
         logs["weight_norm"] = optax.global_norm(params)
         return params, opt_state, new_popart, logs
 
+    def _train_step_replay_impl(
+        self,
+        params,
+        opt_state,
+        popart_state,
+        target_params,
+        obs,
+        first,
+        actions,
+        behaviour_logits,
+        rewards,
+        cont,
+        tasks,
+        agent_state,
+    ):
+        """One IMPACT surrogate step (ops.losses.impact_loss): the target
+        net re-forwards the unroll to anchor the V-trace corrections and
+        the clipped learner/target ratio; the grad-clip + optimizer tail
+        is identical to `_train_step_impl`. `target_params` is NOT
+        donated — the same pinned copy serves every step until the
+        TargetParamStore refreshes it. `popart_state` is threaded
+        untouched (replay validates PopArt off) so both step programs
+        share one output signature."""
+        cfg = self._config.loss
+        rp = self._config.replay
+        target_out, _ = self._agent.unroll(
+            target_params, obs, first, agent_state
+        )
+        target_logits = jax.lax.stop_gradient(
+            target_out.policy_logits[:-1]
+        )
+
+        def loss_fn(p):
+            net_out, _ = self._agent.unroll(p, obs, first, agent_state)
+            values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
+            out = impact_loss(
+                learner_logits=net_out.policy_logits[:-1],
+                target_logits=target_logits,
+                behaviour_logits=behaviour_logits,
+                values=values[:-1],
+                bootstrap_value=values[-1],
+                actions=actions,
+                rewards=rewards,
+                discounts=cfg.discount * cont,
+                clip_epsilon=rp.target_clip_epsilon,
+                config=cfg,
+            )
+            return out.total, out.logs
+
+        (_, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        grad_norm = optax.global_norm(grads)
+        if self._config.max_grad_norm is not None:
+            scale = jnp.minimum(
+                1.0, self._config.max_grad_norm / (grad_norm + 1e-8)
+            )
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, opt_state = self._optimizer.update(
+            grads, opt_state, params
+        )
+        params = optax.apply_updates(params, updates)
+        logs = dict(logs)
+        logs["grad_norm_unclipped"] = grad_norm
+        logs["weight_norm"] = optax.global_norm(params)
+        return params, opt_state, popart_state, logs
+
     def _train_multi_impl(
         self, params, opt_state, popart_state, *stacked
     ):
@@ -1089,7 +1245,9 @@ class Learner:
                 return
         self._ring_pending[slot] = leaves
 
-    def _next_batch_lineage(self, lineage, versions) -> BatchLineage:
+    def _next_batch_lineage(
+        self, lineage, versions, reuse_count: int = 1, staleness: int = 0
+    ) -> BatchLineage:
         """Stamp the next batch id on the consumed unrolls' provenance
         (batcher thread only — the sequence needs no lock)."""
         bid = self._batch_seq
@@ -1098,6 +1256,8 @@ class Learner:
             batch=bid,
             lineage=tuple(lineage),
             versions=tuple(int(v) for v in versions),
+            reuse_count=int(reuse_count),
+            staleness=int(staleness),
         )
         self._last_lineage = meta
         return meta
@@ -1312,7 +1472,12 @@ class Learner:
             view = ring.pop_ready(timeout=0.5)
             if view is None:
                 continue
-            meta = self._next_batch_lineage(view.lineage, view.versions)
+            meta = self._next_batch_lineage(
+                view.lineage,
+                view.versions,
+                reuse_count=view.reuse_count,
+                staleness=view.staleness,
+            )
             stack_t0 = time.monotonic_ns()
             with self._m_host_stack.time():
                 arrays = view.arrays
@@ -1452,6 +1617,28 @@ class Learner:
             self._m_batch_wait.observe(wait)
         step_t0 = time.monotonic()
         step_t0_ns = time.monotonic_ns()
+        if self._replay_step is not None:
+            # IMPACT path: the pinned target params ride as a fourth
+            # (non-donated) state arg. current() raises past the
+            # configured staleness bound — a mis-wired refresh cadence
+            # fails loudly instead of training against an ancient
+            # anchor.
+            _, target_params = self._target_store.current()
+            (
+                self._params,
+                self._opt_state,
+                self._popart_state,
+                logs,
+            ) = self._replay_step(
+                self._params,
+                self._opt_state,
+                self._popart_state,
+                target_params,
+                *arrays,
+            )
+            return self._finish_step(
+                logs, batch_version, meta, step_t0, step_t0_ns
+            )
         step = (
             self._auto_compiled
             if self._auto_compiled is not None
@@ -1525,6 +1712,16 @@ class Learner:
                     *arrays,
                 )
             )
+        return self._finish_step(
+            logs, batch_version, meta, step_t0, step_t0_ns
+        )
+
+    def _finish_step(
+        self, logs, batch_version, meta, step_t0, step_t0_ns
+    ) -> Mapping[str, Any]:
+        """Post-step bookkeeping shared by the standard and replay
+        paths: counters, trace span, publish/log cadence, target-network
+        refresh and ring staleness watermark."""
         # Host-observed dispatch+compute time of the XLA step. On an
         # async-dispatch backend the tail of the compute may overlap the
         # next host iteration; the steady-state EWMA still tracks the
@@ -1535,6 +1732,13 @@ class Learner:
         K = self._config.steps_per_dispatch
         self.num_frames += T * self._config.batch_size * K
         self.num_steps += K
+        if self._replay is not None:
+            # Advance the ring's staleness watermark (expires retained
+            # slots eagerly) and refresh the target on its cadence.
+            self.traj_ring.note_version(self.num_frames)
+            self._target_store.maybe_update(
+                self.num_steps, self._params, self.num_frames
+            )
         self._m_param_lag.set(self.num_frames - batch_version)
         # The trace side of the staleness story: EXACT per-unroll lags
         # for THIS batch (frame counter after the update minus each
@@ -1561,6 +1765,13 @@ class Learner:
                     max(lags) if lags
                     else self.num_frames - batch_version
                 ),
+                # Replay lineage (ISSUE 9 satellite): one ring slot has
+                # one slot-level reuse_count, so min == max today; the
+                # pair keeps the schema stable for a future multi-slot
+                # fused batch.
+                "reuse_min": meta.reuse_count,
+                "reuse_max": meta.reuse_count,
+                "staleness": meta.staleness,
             },
         )
         self._telemetry.heartbeat("learner")
@@ -1762,6 +1973,16 @@ class Learner:
 
             self._rng = unpack_rng(state["rng"])
         self._publish()
+        if self._target_store is not None:
+            # Re-pin the target from the restored params: a resumed run
+            # must not clip against the pre-restore policy (and the old
+            # target's lag bound would trip against the restored frame
+            # counter).
+            self._target_store.update(
+                self._params,
+                version=self.num_frames,
+                step=self.num_steps,
+            )
 
     # ---- introspection -------------------------------------------------
 

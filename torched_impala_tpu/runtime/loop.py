@@ -387,8 +387,11 @@ def train(
 
     # Zero-copy trajectory ring (LearnerConfig.traj_ring): actors write
     # unrolls straight into shared learner batch slots instead of
-    # enqueueing Trajectories. Every actor's env-column block must divide
-    # the batch so blocks never straddle a slot — checked HERE, where the
+    # enqueueing Trajectories. With LearnerConfig.replay the same ring
+    # retains released slots for IMPACT-style reuse (replay/ package) —
+    # the divisibility contract below is unchanged because replay only
+    # re-delivers already-committed slots. Every actor's env-column
+    # block must divide the batch so blocks never straddle a slot — checked HERE, where the
     # actual fleet shapes are known, so a bad combination fails at
     # startup instead of deadlocking the ring.
     traj_ring = learner.traj_ring

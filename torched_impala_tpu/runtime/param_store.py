@@ -10,8 +10,9 @@ semantic-race checks in tests.
 Beyond the latest-only cell, the store retains a keep-last-K ring of
 recent versions (`get_version`): the serving tier's `VersionRegistry`
 pins concrete versions for A/B + shadow routing (serving/registry.py),
-and IMPACT-style target networks (ROADMAP sample-reuse item) read a
-version pinned N publishes ago. Retention is bounded — publishing
+and IMPACT-style target networks (replay/target_store.py wraps this
+store to pin an on-device target snapshot every N learner steps) read
+pinned versions. Retention is bounded — publishing
 version K+1 evicts the oldest — so the ring can never grow host memory
 without bound.
 """

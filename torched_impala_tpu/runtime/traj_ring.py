@@ -32,6 +32,19 @@ slots not free, read at snapshot time), `ring/acquire_block_ms`
 (actor-side wait for a free column block), `ring/recycle_wait_ms`
 (batcher-side wait for a slot's device copy before recycling),
 `ring/batches`, `ring/aborted_slots`.
+
+Replay mode (``max_reuse > 1`` — the replay/ subsystem, docs/REPLAY.md):
+released slots are RETAINED instead of recycled and a seeded,
+staleness-weighted sampler re-delivers them through `pop_ready` until
+their per-slot `reuse_count` hits ``max_reuse`` or the staleness bound
+(`note_version` delta) expires them. Fresh slots always win over
+replays; under free-list pressure `acquire` evicts the stalest retained
+slot rather than block an actor; a delivered slot is never on the
+retained list, so eviction can never recycle buffers mid-consumption
+(the generation counter stays the torn-write guard for stale writers).
+With ``max_reuse == 1`` every code path below is byte-for-byte today's
+behavior and no ``replay/*`` series are registered — the bit-parity
+contract tests/test_replay.py pins.
 """
 
 from __future__ import annotations
@@ -89,11 +102,19 @@ class ReadySlot(NamedTuple):
     param_version: int
     lineage: tuple = ()
     versions: tuple = ()
+    # Replay provenance (defaults keep non-replay constructors valid):
+    # `gen` snapshots the slot generation at delivery, `reuse_count` is
+    # which delivery of this slot's contents this is (1 = fresh), and
+    # `staleness` the frame delta between the learner's last
+    # `note_version` and the slot's acting param version.
+    gen: int = 0
+    reuse_count: int = 1
+    staleness: int = 0
 
 
 class _Slot:
     __slots__ = ("buffers", "versions", "gen", "next_col", "committed",
-                 "aborted", "lineage")
+                 "aborted", "lineage", "reuse_count", "delivered")
 
     def __init__(self, buffers: Trajectory, batch_size: int):
         self.buffers = buffers
@@ -105,6 +126,8 @@ class _Slot:
         # col_start -> (lineage_id, param_version) per committed block;
         # pop_ready flattens it in column order.
         self.lineage: dict = {}
+        self.reuse_count = 0  # deliveries of the current contents
+        self.delivered = False  # currently consumed by the batcher
 
 
 class TrajectoryRing:
@@ -122,6 +145,10 @@ class TrajectoryRing:
         agent_state_example: Any = (),
         telemetry: Optional[Registry] = None,
         tracer: Optional[FlightRecorder] = None,
+        max_reuse: int = 1,
+        replay_mix: float = 1.0,
+        staleness_frames: int = 0,
+        sampler_seed: int = 0,
     ) -> None:
         if num_slots < 2:
             # One slot can never overlap filling with an in-flight H2D
@@ -129,6 +156,14 @@ class TrajectoryRing:
             raise ValueError(f"need >= 2 slots, got {num_slots}")
         if unroll_length < 1 or batch_size < 1:
             raise ValueError("unroll_length and batch_size must be >= 1")
+        if max_reuse < 1:
+            raise ValueError(f"max_reuse must be >= 1, got {max_reuse}")
+        if not (0.0 < replay_mix <= 1.0):
+            raise ValueError(f"replay_mix must be in (0, 1], got {replay_mix}")
+        if staleness_frames < 0:
+            raise ValueError(
+                f"staleness_frames must be >= 0, got {staleness_frames}"
+            )
         obs = np.asarray(example_obs)
         T, B = unroll_length, batch_size
         self.unroll_length = T
@@ -172,12 +207,30 @@ class TrajectoryRing:
         self._closed = False
         self._cond = threading.Condition()
 
+        # -- replay state (inert while max_reuse == 1) ------------------
+        self.max_reuse = int(max_reuse)
+        self.replay_mix = float(replay_mix)
+        self.staleness_frames = int(staleness_frames)
+        self._retained: List[int] = []  # released, reuse budget left
+        self._current_version = 0  # learner frame watermark (note_version)
+        self._fresh_delivered = 0
+        self._replay_delivered = 0
+        self._sampler = np.random.default_rng(sampler_seed)
+
         reg = telemetry if telemetry is not None else get_registry()
         self._tracer = tracer if tracer is not None else get_recorder()
         self._m_acquire_ms = reg.histogram("ring/acquire_block_ms")
         self._m_recycle_ms = reg.histogram("ring/recycle_wait_ms")
         self._m_batches = reg.counter("ring/batches")
         self._m_aborted = reg.counter("ring/aborted_slots")
+        if self.max_reuse > 1:
+            # Registered only in replay mode so the disabled ring's
+            # snapshot key set stays exactly today's (parity contract).
+            self._m_reuse_delivered = reg.counter("replay/reuse_delivered")
+            self._m_reuse_count = reg.histogram("replay/reuse_count")
+            self._m_evict = reg.counter("replay/evict_pressure")
+            self._m_stale_expired = reg.counter("replay/staleness_expired")
+            self._m_staleness = reg.gauge("replay/staleness_frames")
         # Occupancy (fraction of slots not on the free list) is read
         # lazily at snapshot time; weakref so the global registry never
         # keeps a dead ring's slot buffers alive.
@@ -214,6 +267,10 @@ class TrajectoryRing:
             while True:
                 if self._closed:
                     raise QueueClosed()
+                if self._filling is None and not self._free and self._retained:
+                    # Free-list pressure: actors NEVER block on replayed
+                    # data — evict the stalest retained slot instead.
+                    self._evict_locked()
                 if self._filling is None and self._free:
                     self._filling = self._free.popleft()
                 if self._filling is not None:
@@ -314,12 +371,24 @@ class TrajectoryRing:
         """Next completed slot as the train step's 8-tuple of batch
         arrays (views — valid until `release`); None on timeout or after
         close. Batch param_version is the min over columns, matching
-        `stack_trajectories`."""
+        `stack_trajectories`.
+
+        Replay mode: fresh slots always win; when none is ready the
+        staleness-weighted sampler may re-deliver a retained slot
+        (subject to the `replay_mix` cap), with `reuse_count` /
+        `staleness` stamped on the ReadySlot for lineage."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
-            while not self._ready:
+            while True:
+                if self._ready:
+                    return self._deliver_locked(
+                        self._ready.popleft(), fresh=True
+                    )
                 if self._closed:
                     return None
+                s = self._sample_replay_locked()
+                if s is not None:
+                    return self._deliver_locked(s, fresh=False)
                 budget = (
                     None if deadline is None
                     else deadline - time.monotonic()
@@ -327,37 +396,160 @@ class TrajectoryRing:
                 if budget is not None and budget <= 0:
                     return None
                 self._cond.wait(timeout=budget)
-            s = self._ready.popleft()
-            slot = self._slots[s]
-            self._m_batches.inc()
-            buf = slot.buffers
-            blocks = [slot.lineage[c] for c in sorted(slot.lineage)]
-            return ReadySlot(
-                slot=s,
-                arrays=(
-                    buf.obs,
-                    buf.first,
-                    buf.actions,
-                    buf.behaviour_logits,
-                    buf.rewards,
-                    buf.cont,
-                    buf.task,
-                    buf.agent_state,
-                ),
-                param_version=int(slot.versions.min()),
-                lineage=tuple(lid for lid, _ in blocks),
-                versions=tuple(v for _, v in blocks),
+
+    def _deliver_locked(self, s: int, fresh: bool) -> ReadySlot:
+        slot = self._slots[s]
+        slot.delivered = True
+        staleness = max(
+            0, self._current_version - int(slot.versions.min())
+        )
+        if fresh:
+            slot.reuse_count = 1
+            self._fresh_delivered += 1
+        else:
+            slot.reuse_count += 1
+            self._replay_delivered += 1
+            self._m_reuse_delivered.inc()
+            self._m_staleness.set(float(staleness))
+            self._tracer.instant(
+                "ring/replay",
+                {"slot": s, "reuse": slot.reuse_count,
+                 "staleness": staleness},
             )
+        self._m_batches.inc()
+        buf = slot.buffers
+        blocks = [slot.lineage[c] for c in sorted(slot.lineage)]
+        return ReadySlot(
+            slot=s,
+            arrays=(
+                buf.obs,
+                buf.first,
+                buf.actions,
+                buf.behaviour_logits,
+                buf.rewards,
+                buf.cont,
+                buf.task,
+                buf.agent_state,
+            ),
+            param_version=int(slot.versions.min()),
+            lineage=tuple(lid for lid, _ in blocks),
+            versions=tuple(v for _, v in blocks),
+            gen=slot.gen,
+            reuse_count=slot.reuse_count,
+            staleness=staleness,
+        )
 
     def release(self, s: int) -> None:
         """Return slot `s` to the free list (generation bump invalidates
         any stale blocks). Call only once its batch arrays are no longer
         referenced — after the H2D copy completed (or after an owning
-        host copy was taken)."""
+        host copy was taken).
+
+        Replay mode: a slot with reuse budget left and inside the
+        staleness bound is RETAINED (no generation bump — its contents
+        stay live for re-delivery) instead of recycled."""
         with self._cond:
-            self._recycle_locked(s)
+            slot = self._slots[s]
+            slot.delivered = False
+            if (
+                self.max_reuse > 1
+                and not self._closed
+                and slot.reuse_count < self.max_reuse
+                and not self._is_stale_locked(slot)
+            ):
+                self._retained.append(s)
+            else:
+                if self.max_reuse > 1:
+                    self._m_reuse_count.observe(float(slot.reuse_count))
+                    if slot.reuse_count < self.max_reuse:
+                        # Budget was left; the staleness bound ended it.
+                        self._m_stale_expired.inc()
+                self._recycle_locked(s)
             self._cond.notify_all()
         self._tracer.instant("ring/release", {"slot": s})
+
+    # -- replay (retain-after-release) internals ---------------------------
+
+    def note_version(self, version: int) -> None:
+        """Advance the learner's frame watermark (num_frames after each
+        step); staleness of retained/delivered slots is measured against
+        it, and newly-stale retained slots are expired eagerly so the
+        sampler never draws them."""
+        with self._cond:
+            if version > self._current_version:
+                self._current_version = int(version)
+            self._expire_stale_locked()
+
+    def _is_stale_locked(self, slot: _Slot) -> bool:
+        if self.staleness_frames <= 0:
+            return False
+        delta = self._current_version - int(slot.versions.min())
+        return delta > self.staleness_frames
+
+    def _expire_stale_locked(self) -> None:
+        if self.staleness_frames <= 0 or not self._retained:
+            return
+        keep: List[int] = []
+        expired = False
+        for s in self._retained:
+            if self._is_stale_locked(self._slots[s]):
+                self._m_stale_expired.inc()
+                self._m_reuse_count.observe(
+                    float(self._slots[s].reuse_count)
+                )
+                self._recycle_locked(s)
+                expired = True
+            else:
+                keep.append(s)
+        if expired:
+            self._retained = keep
+            self._cond.notify_all()
+
+    def _evict_locked(self) -> None:
+        """Recycle the retained slot with the oldest acting params
+        (ties: most-reused first) to unblock an acquirer. Only retained
+        slots are candidates — a delivered slot is never on the list, so
+        eviction cannot pull buffers out from under the train step."""
+        s = min(
+            self._retained,
+            key=lambda i: (
+                int(self._slots[i].versions.min()),
+                -self._slots[i].reuse_count,
+            ),
+        )
+        self._retained.remove(s)
+        self._m_evict.inc()
+        self._m_reuse_count.observe(float(self._slots[s].reuse_count))
+        self._recycle_locked(s)
+
+    def _sample_replay_locked(self) -> Optional[int]:
+        """Draw a retained slot for re-delivery, or None when replay is
+        off / nothing retained / the `replay_mix` cap binds. Weights are
+        1 / (1 + staleness): fresher slots are preferred, never
+        exclusively (the seeded rng keeps the draw deterministic)."""
+        if self.max_reuse <= 1 or not self._retained:
+            return None
+        self._expire_stale_locked()
+        if not self._retained:
+            return None
+        if self.replay_mix < 1.0:
+            total = self._fresh_delivered + self._replay_delivered
+            if self._replay_delivered + 1 > self.replay_mix * (total + 1):
+                return None
+        staleness = np.array(
+            [
+                max(
+                    0,
+                    self._current_version
+                    - int(self._slots[s].versions.min()),
+                )
+                for s in self._retained
+            ],
+            np.float64,
+        )
+        w = 1.0 / (1.0 + staleness)
+        idx = int(self._sampler.choice(len(self._retained), p=w / w.sum()))
+        return self._retained.pop(idx)
 
     def release_after_transfer(self, s: int, pending) -> None:
         """Block out slot `s`'s device transfer, then recycle it: until
