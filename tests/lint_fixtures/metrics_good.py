@@ -9,6 +9,9 @@ reg.counter("resilience/checkpoint_bytes")  # pinned sub-family  # noqa: F821
 reg.counter("serving/request_total")  # pinned sub-family  # noqa: F821
 reg.counter("replay/reuse_delivered")  # pinned sub-family (3d)  # noqa: F821
 reg.gauge("replay/target_lag")  # pinned sub-family (3d)  # noqa: F821
+reg.gauge("perf/mfu")  # bare family name passes 3e  # noqa: F821
+reg.gauge("perf/membw_util")  # pinned sub-family (3e)  # noqa: F821
+reg.counter("perf/fused_fallbacks")  # pinned sub-family (3e)  # noqa: F821
 key = "telemetry/pool/restarts"
 rec.instant("ring/commit", {"lid": "a0u0"})  # noqa: F821
 rec.complete("serving/request", 0, 1)  # pinned trace set  # noqa: F821

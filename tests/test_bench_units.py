@@ -189,6 +189,47 @@ def test_serving_bench_coalescing_shadow_and_parity(jax_cpu):
     assert out["bf16_parity"], out
 
 
+def test_perfgate_gates_tiny_bench_history(jax_cpu, tmp_path, monkeypatch):
+    """The ISSUE 10 bench-history loop, end to end on CI: a tiny bench
+    section appends `tiny_*` records to $BENCH_HISTORY_PATH, perfgate
+    passes the fresh history (exit 0), and a seeded 25% throughput
+    regression on the same (metric, fingerprint) group fails it
+    (exit 1) — the exact workflow the full bench runs through on the
+    TPU box, minus the pinned budgets (scoped to TPU fingerprints)."""
+    from tools import perfgate
+
+    hist = str(tmp_path / "BENCH_HISTORY.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY_PATH", hist)
+    from bench import run_bench_tracing
+
+    run_bench_tracing(jax_cpu, tiny=True)
+    records = perfgate.load_history(hist)
+    assert records, "tiny bench section wrote no history records"
+    rec = records[-1]
+    assert rec["metric"].startswith("tiny_"), rec
+    assert rec["sha"] and rec["fingerprint"], rec
+    assert perfgate.main(["--history", hist]) == 0
+    # Grow the group past --min-prior, then seed a 20% drop.
+    for _ in range(3):
+        perfgate.append_history(
+            rec["section"],
+            rec["metric"],
+            rec["value"],
+            path=hist,
+            direction=rec["direction"],
+            fingerprint=rec["fingerprint"],
+        )
+    perfgate.append_history(
+        rec["section"],
+        rec["metric"],
+        rec["value"] * 0.75,
+        path=hist,
+        direction=rec["direction"],
+        fingerprint=rec["fingerprint"],
+    )
+    assert perfgate.main(["--history", hist]) == 1
+
+
 def test_tracing_bench_overhead_bound(jax_cpu):
     """The ISSUE 4 acceptance bound, wired into CI via the bench
     section's tiny variant: the flight recorder stays negligible with

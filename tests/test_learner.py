@@ -408,6 +408,58 @@ def test_fused_dispatch_matches_sequential_steps(use_lstm):
     )
 
 
+def test_fused_fallback_chunked_matches_full_dispatch():
+    """The learner_fused K8 layout-crash fix (ISSUE 10 satellite): when a
+    K>4 superbatch is refused at the jit boundary the learner falls back
+    to chunked K<=4 dispatch through the same scan body. The chunked
+    path must be numerically identical to the one-shot K=8 dispatch
+    (state threads through the chunks exactly as through one scan),
+    keep the frame/step accounting, and count on perf/fused_fallbacks."""
+    T, B, K = 5, 2, 8
+    results = {}
+    for forced in (False, True):
+        agent = _agent()
+        learner = Learner(
+            agent=agent,
+            optimizer=optax.sgd(1e-2),
+            config=LearnerConfig(
+                batch_size=B,
+                unroll_length=T,
+                steps_per_dispatch=K,
+                queue_capacity=K * B,
+            ),
+            example_obs=np.zeros((4,), np.float32),
+            rng=jax.random.key(0),
+        )
+        _push_unrolls(learner, agent, K * B, T)
+        if forced:
+            # What the jit-boundary ValueError handler sets on a real
+            # layout refusal (exercised end to end on TPU backends
+            # only; the chunked execution path itself is backend-free).
+            learner._fused_fallback_k = 4
+        before = learner._m_fused_fallbacks.value
+        learner.start()
+        logs = learner.step_once(timeout=60)
+        learner.stop()
+        assert learner.num_frames == K * B * T
+        assert learner.num_steps == K
+        assert learner._m_fused_fallbacks.value == before + (
+            1 if forced else 0
+        )
+        results[forced] = (
+            jax.tree.map(np.asarray, learner.params),
+            float(logs["total_loss"]),
+        )
+    np.testing.assert_allclose(
+        results[False][1], results[True][1], rtol=1e-5, atol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        results[False][0],
+        results[True][0],
+    )
+
+
 def test_fused_dispatch_sharded():
     """Fused K=3 dispatch over the 8-device data mesh: superbatch leading
     axis unsharded, batch axis sharded, params replicated throughout."""

@@ -1,0 +1,429 @@
+"""Performance observatory units (ISSUE 10): cost model + fallback,
+overlap-analyzer interval arithmetic on synthetic flight-recorder
+traces, report rendering/writing, and the perfgate exit-code contract.
+
+Synthetic traces use the recorder's own record shape — the
+`(ts_ns, dur_ns, phase, name, tid, args)` 6-tuples of
+`FlightRecorder.tail()` — so the analyzer is tested against the real
+interface, not a private fixture format.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from torched_impala_tpu.perf import (  # noqa: E402
+    CostModel,
+    RootCost,
+    analyze_records,
+    categorize_span,
+    extract_compiled_cost,
+    generate_report,
+    measure,
+    render_report,
+    static_flops_estimate,
+    subtract,
+    union,
+    write_report,
+)
+from torched_impala_tpu.telemetry import Registry  # noqa: E402
+
+MS = 1_000_000  # ns
+
+
+def _span(t0_ms, dur_ms, name, args=None, tid=1):
+    return (t0_ms * MS, dur_ms * MS, "X", name, tid, args)
+
+
+# ---- cost model ----------------------------------------------------------
+
+
+def test_static_flops_estimate():
+    # 6 FLOPs per param per frame: 10 params x 4 frames.
+    assert static_flops_estimate(10, 4) == 240.0
+
+
+def test_extract_compiled_cost_never_raises():
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("no backend")
+
+    out = extract_compiled_cost(Broken())
+    assert out == {"flops": 0.0, "bytes_accessed": 0.0, "temp_bytes": 0.0}
+
+
+def test_extract_compiled_cost_handles_both_shapes():
+    class ListShaped:
+        def cost_analysis(self):
+            return [{"flops": 7.0, "bytes accessed": 3.0}]
+
+    class DictShaped:
+        def cost_analysis(self):
+            return {"flops": 7.0, "bytes accessed": 3.0}
+
+    for compiled in (ListShaped(), DictShaped()):
+        out = extract_compiled_cost(compiled)
+        assert out["flops"] == 7.0 and out["bytes_accessed"] == 3.0
+
+
+def test_extract_compiled_cost_on_real_executable():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((32, 32), jnp.float32)
+    compiled = jax.jit(lambda a: (a @ a).sum()).lower(x).compile()
+    out = extract_compiled_cost(compiled)
+    # The CPU backend may or may not report costs; the contract is only
+    # "well-formed and non-negative" — the fallback test below pins the
+    # nonzero path.
+    assert out["flops"] >= 0.0 and out["bytes_accessed"] >= 0.0
+
+
+def test_cost_model_static_fallback_and_gauges():
+    import jax.numpy as jnp
+
+    reg = Registry()
+    cm = CostModel(registry=reg)
+
+    class NoCosts:
+        def cost_analysis(self):
+            return []
+
+    root = cm.register_root(
+        "train_step",
+        compiled=NoCosts(),
+        fallback_params={"w": jnp.ones((8, 8)), "b": jnp.ones((8,))},
+        frames_per_call=100,
+        steps_per_call=2,
+    )
+    assert isinstance(root, RootCost)
+    assert root.source == "static"
+    assert root.flops == 6.0 * 72 * 100
+    mfu = cm.observe_call("train_step", dt_seconds=1e-3)
+    assert mfu > 0.0
+    snap = reg.snapshot()
+    assert snap["telemetry/perf/mfu"] == pytest.approx(mfu)
+    # Per-SGD-step gauge divides the per-call count by steps_per_call.
+    assert snap["telemetry/perf/flops_per_step"] == pytest.approx(
+        root.flops / 2
+    )
+
+
+def test_cost_model_flops_scale_corrects_scan_bodies():
+    reg = Registry()
+    cm = CostModel(registry=reg)
+
+    class BodyOnce:
+        def cost_analysis(self):
+            return [{"flops": 1000.0, "bytes accessed": 10.0}]
+
+    root = cm.register_root(
+        "train_step", compiled=BodyOnce(), flops_scale=4.0
+    )
+    assert root.source == "cost_analysis"
+    assert root.flops == 4000.0
+
+
+def test_cost_model_roofline_bound():
+    cm = CostModel(
+        registry=Registry(), peak_flops=100.0, peak_bytes_per_s=10.0
+    )
+
+    class C:
+        def __init__(self, flops, b):
+            self._c = {"flops": flops, "bytes accessed": b}
+
+        def cost_analysis(self):
+            return self._c
+
+    cm.register_root("hot", compiled=C(1000.0, 10.0))  # AI 100 > ridge 10
+    cm.register_root("cold", compiled=C(10.0, 10.0))  # AI 1 < ridge 10
+    assert cm.roofline("hot")["bound"] == "compute"
+    assert cm.roofline("cold")["bound"] == "memory"
+    assert cm.roofline("missing") == {}
+    assert set(cm.snapshot()) == {"hot", "cold"}
+
+
+def test_observe_call_unknown_root_is_zero():
+    cm = CostModel(registry=Registry())
+    assert cm.observe_call("nope", 1.0) == 0.0
+
+
+# ---- interval arithmetic -------------------------------------------------
+
+
+def test_union_merges_and_drops_empty():
+    assert union([(5, 7), (0, 2), (1, 3), (9, 9)]) == [(0, 3), (5, 7)]
+
+
+def test_subtract_partial_overlaps():
+    removed, remaining = subtract([(0, 10)], [(2, 4), (6, 8)])
+    assert removed == 4
+    assert remaining == [(0, 2), (4, 6), (8, 10)]
+    assert measure(remaining) == 6
+
+
+def test_subtract_no_overlap():
+    removed, remaining = subtract([(0, 5)], [(10, 20)])
+    assert removed == 0 and remaining == [(0, 5)]
+
+
+# ---- overlap analyzer ----------------------------------------------------
+
+
+def test_categorize_span_priority_families():
+    assert categorize_span("learner/publish") == "publish"
+    assert categorize_span("learner/device_put") == "h2d"
+    assert categorize_span("learner/host_stack") == "feed"
+    assert categorize_span("queue/enqueue") == "feed"
+    assert categorize_span("ring/commit") == "feed"
+    assert categorize_span("learner/compile_wait") == "compile"
+    assert categorize_span("learner/train_step") is None
+    assert categorize_span("watchdog/stall") is None
+
+
+def test_analyze_empty_and_no_steps():
+    assert analyze_records([])["learner"] == {"steps": 0}
+    rep = analyze_records([_span(0, 5, "queue/enqueue")])
+    assert rep["learner"] == {"steps": 0}
+    assert rep["span_counts"] == {"queue/enqueue": 1}
+
+
+def test_analyze_attributes_gaps_by_priority():
+    # Two steps with a 10ms gap; publish and feed BOTH cover [10,14):
+    # publish (higher priority) wins the disputed interval, feed only
+    # charges its uncontested [14, 18), and [18, 20) is unattributed.
+    records = [
+        _span(0, 10, "learner/train_step", {}),
+        _span(10, 4, "learner/publish"),
+        _span(10, 8, "learner/host_stack"),
+        _span(20, 10, "learner/train_step", {}),
+    ]
+    learner = analyze_records(records)["learner"]
+    assert learner["steps"] == 2
+    assert learner["wall_clock_s"] == pytest.approx(0.030)
+    assert learner["compute_s"] == pytest.approx(0.020)
+    assert learner["gap_total_s"] == pytest.approx(0.010)
+    assert learner["gaps_s"]["publish"] == pytest.approx(0.004)
+    assert learner["gaps_s"]["feed"] == pytest.approx(0.004)
+    assert learner["gaps_s"]["unattributed"] == pytest.approx(0.002)
+    assert learner["coverage_frac"] == pytest.approx(1.0)
+    assert learner["attributed_frac"] == pytest.approx(28 / 30)
+
+
+def test_analyze_pipelined_feeder_only_charges_gap_portion():
+    # The feeder span [5, 15) overlaps step one (healthy pipelining);
+    # only its in-gap part [10, 12) may be charged.
+    records = [
+        _span(0, 10, "learner/train_step", {}),
+        _span(5, 10, "learner/host_stack"),
+        _span(12, 10, "learner/train_step", {}),
+    ]
+    learner = analyze_records(records)["learner"]
+    assert learner["gaps_s"]["feed"] == pytest.approx(0.002)
+    assert learner["gaps_s"]["unattributed"] == 0.0
+    assert learner["coverage_frac"] == pytest.approx(1.0)
+
+
+def test_analyze_splits_fresh_from_replayed():
+    # BatchLineage convention: reuse_count 1 == fresh first delivery;
+    # only re-deliveries (> 1) count as replayed.
+    records = [
+        _span(0, 10, "learner/train_step", {"reuse_max": 1}),
+        _span(12, 10, "learner/train_step", {"reuse_max": 3, "staleness": 640}),
+        _span(24, 10, "learner/train_step", {"reuse_max": 2, "staleness": 320}),
+        _span(36, 10, "learner/train_step", {}),  # no lineage: fresh
+    ]
+    learner = analyze_records(records)["learner"]
+    assert learner["fresh"]["steps"] == 2
+    assert learner["replayed"]["steps"] == 2
+    assert learner["replayed"]["compute_s"] == pytest.approx(0.020)
+    assert learner["replayed"]["reuse_mean"] == pytest.approx(2.5)
+    assert learner["replayed"]["staleness_mean"] == pytest.approx(480.0)
+
+
+def test_analyze_skips_non_complete_phases():
+    records = [
+        _span(0, 10, "learner/train_step", {}),
+        (5 * MS, 0, "i", "ring/commit", 1, None),  # instant: ignored
+        _span(12, 10, "learner/train_step", {}),
+        None,  # empty ring slot
+    ]
+    learner = analyze_records(records)["learner"]
+    assert learner["steps"] == 2
+
+
+# ---- report rendering / writing ------------------------------------------
+
+
+def test_render_and_write_report(tmp_path):
+    records = [
+        _span(0, 10, "learner/train_step", {}),
+        _span(10, 2, "learner/device_put"),
+        _span(12, 10, "learner/train_step", {"reuse_max": 2}),
+    ]
+    roofline = {
+        "train_step": {
+            "root": "train_step",
+            "source": "static",
+            "flops_per_step": 2e9,
+            "arithmetic_intensity": 300.0,
+            "ridge_intensity": 240.5,
+            "bound": "compute",
+        }
+    }
+    path = str(tmp_path / "perf.json")
+    report = generate_report(path, records=records, roofline=roofline)
+    text = render_report(report)
+    assert "2 steps" in text
+    assert "gap:h2d" in text
+    assert "replayed: 1/2 steps" in text
+    assert "compute-bound" in text
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["learner"]["steps"] == 2
+    assert on_disk["roofline"] == roofline
+    with open(str(tmp_path / "perf.txt")) as f:
+        assert f.read() == text
+
+
+def test_write_report_non_json_suffix(tmp_path):
+    path = str(tmp_path / "perf.out")
+    txt = write_report({"schema": 1, "span_counts": {}}, path)
+    assert txt == path + ".txt"
+    assert os.path.exists(path) and os.path.exists(txt)
+
+
+# ---- perfgate ------------------------------------------------------------
+
+
+def _gate(tmp_path):
+    from tools import perfgate
+
+    return perfgate, str(tmp_path / "BENCH_HISTORY.jsonl")
+
+
+def _seed(perfgate, path, values, metric="fps", direction="higher"):
+    for v in values:
+        perfgate.append_history(
+            "headline",
+            metric,
+            v,
+            path=path,
+            direction=direction,
+            sha="test",
+            fingerprint="testbox|x86_64|cpu1",
+        )
+
+
+def test_perfgate_missing_and_empty_history_exit_2(tmp_path):
+    perfgate, path = _gate(tmp_path)
+    assert perfgate.main(["--history", path]) == 2
+    open(path, "w").close()
+    assert perfgate.main(["--history", path]) == 2
+    assert perfgate.main(["--history", path, "--drop", "1.5"]) == 2
+
+
+def test_perfgate_fresh_history_exits_0(tmp_path):
+    perfgate, path = _gate(tmp_path)
+    _seed(perfgate, path, [100.0])
+    assert perfgate.main(["--history", path]) == 0
+
+
+def test_perfgate_catches_20pct_drop(tmp_path):
+    perfgate, path = _gate(tmp_path)
+    _seed(perfgate, path, [100.0, 101.0, 99.0, 100.0, 80.0])
+    assert perfgate.main(["--history", path]) == 1
+    findings = perfgate.check_records(perfgate.load_history(path))
+    assert len(findings) == 1 and "below the trailing median" in findings[0]
+
+
+def test_perfgate_needs_min_prior_before_relative_check(tmp_path):
+    perfgate, path = _gate(tmp_path)
+    # Two priors only: the relative check must stay disarmed.
+    _seed(perfgate, path, [100.0, 100.0, 50.0])
+    assert perfgate.main(["--history", path]) == 0
+    assert perfgate.main(["--history", path, "--min-prior", "2"]) == 1
+
+
+def test_perfgate_lower_is_better_direction(tmp_path):
+    perfgate, path = _gate(tmp_path)
+    _seed(
+        perfgate,
+        path,
+        [10.0, 10.0, 10.0, 10.0, 13.0],
+        metric="stack_ms",
+        direction="lower",
+    )
+    assert perfgate.main(["--history", path]) == 1
+    _seed(perfgate, path, [9.0], metric="stack_ms", direction="lower")
+    # Newest is healthy again; only the newest record per group gates.
+    assert perfgate.main(["--history", path]) == 0
+
+
+def test_perfgate_budget_scoped_by_fingerprint(tmp_path):
+    from tools import perfgate
+
+    path = str(tmp_path / "h.jsonl")
+    budgets = {"fps": {"min": 90.0, "fingerprint_contains": "tpu"}}
+    perfgate.append_history(
+        "headline", "fps", 50.0, path=path, sha="t", fingerprint="cpubox"
+    )
+    records = perfgate.load_history(path)
+    # CPU fingerprint: the TPU floor must not apply.
+    assert perfgate.check_records(records, budgets=budgets) == []
+    perfgate.append_history(
+        "headline", "fps", 50.0, path=path, sha="t", fingerprint="v5e|tpu"
+    )
+    findings = perfgate.check_records(
+        perfgate.load_history(path), budgets=budgets
+    )
+    assert len(findings) == 1 and "pinned budget min" in findings[0]
+
+
+def test_perfgate_groups_are_per_machine(tmp_path):
+    from tools import perfgate
+
+    path = str(tmp_path / "h.jsonl")
+    # 4 fast records on box A, then one slow record on box B: no
+    # cross-machine comparison may fire.
+    for v in (100.0, 100.0, 100.0, 100.0):
+        perfgate.append_history(
+            "headline", "fps", v, path=path, sha="t", fingerprint="boxA"
+        )
+    perfgate.append_history(
+        "headline", "fps", 10.0, path=path, sha="t", fingerprint="boxB"
+    )
+    assert perfgate.check_records(perfgate.load_history(path)) == []
+
+
+def test_perfgate_skips_malformed_lines(tmp_path):
+    from tools import perfgate
+
+    path = str(tmp_path / "h.jsonl")
+    perfgate.append_history(
+        "headline", "fps", 100.0, path=path, sha="t", fingerprint="box"
+    )
+    with open(path, "a") as f:
+        f.write('{"truncated": \n')
+        f.write("not json at all\n")
+        f.write('{"metric": "fps", "value": "NaN-ish-string"}\n')
+    records = perfgate.load_history(path)
+    assert len(records) == 1
+    assert perfgate.main(["--history", path]) == 0
+
+
+def test_perfgate_env_var_override(tmp_path, monkeypatch):
+    from tools import perfgate
+
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY_PATH", path)
+    rec = perfgate.append_history(
+        "headline", "fps", 42.0, sha="t", fingerprint="box"
+    )
+    assert rec["value"] == 42.0
+    assert os.path.exists(path)

@@ -17,8 +17,9 @@ Rules (rule ids in parentheses):
 3. literal emitted keys (``"telemetry/..."`` strings,
    ``f"{PREFIX}/..."`` interpolations) carry the same grammar
    (``telemetry/literal-key``);
-3b/3c/3d. ``resilience/*``, ``serving/*`` and ``replay/*`` names use
-   their pinned sub-family prefixes (``telemetry/subfamily-prefix``);
+3b/3c/3d/3e. ``resilience/*``, ``serving/*``, ``replay/*`` and
+   ``perf/*`` names use their pinned sub-family prefixes
+   (``telemetry/subfamily-prefix``);
 4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
    ``.complete`` — follow the same slug grammar
    (``telemetry/trace-grammar``);
@@ -44,8 +45,8 @@ RULES = {
     "telemetry/type-fork": "one metric name registered as two types",
     "telemetry/literal-key": "literal emitted key violates the grammar",
     "telemetry/subfamily-prefix": (
-        "resilience/*, serving/* or replay/* name lacks its pinned "
-        "sub-family prefix"
+        "resilience/*, serving/*, replay/* or perf/* name lacks its "
+        "pinned sub-family prefix"
     ),
     "telemetry/trace-grammar": "trace event name violates the grammar",
     "telemetry/trace-closed-set": (
@@ -76,6 +77,12 @@ SERVING_PREFIXES = (
 # the four sub-families docs/OBSERVABILITY.md documents — reuse
 # accounting, target-store health, eviction pressure, staleness.
 REPLAY_PREFIXES = ("reuse_", "target_", "evict_", "staleness_")
+# Rule 3e (performance observatory, ISSUE 10): the perf/* family is
+# pinned to the five sub-families docs/OBSERVABILITY.md documents —
+# model-flop utilization, memory bandwidth, flop counts, gap
+# attribution, fused-dispatch fallbacks. Checked on `<sub>_` so the
+# bare family names (perf/mfu) pass while perf/mfuzzy does not.
+PERF_PREFIXES = ("mfu_", "membw_", "flops_", "gap_", "fused_")
 SERVING_TRACE_EVENTS = {
     "serving/request", "serving/wave", "serving/shadow",
 }
@@ -151,6 +158,16 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         name,
                         f"replay metric {name!r} must use a "
                         f"sub-family prefix {REPLAY_PREFIXES}",
+                    )
+                    continue
+                if name.startswith("perf/") and not (
+                    name.split("/", 1)[1] + "_"
+                ).startswith(PERF_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"perf metric {name!r} must use a "
+                        f"sub-family prefix {PERF_PREFIXES} (rule 3e)",
                     )
                     continue
                 prev = seen.get(name)

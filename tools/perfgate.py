@@ -1,0 +1,300 @@
+"""perfgate: the BENCH_HISTORY.jsonl regression gate.
+
+``python -m tools.perfgate [--history PATH] [--drop 0.2] [--window 8]``
+
+bench.py (and its tiny variants under tests/test_bench_units.py) append
+one structured record per headline metric to ``BENCH_HISTORY.jsonl``:
+
+    {"ts": ..., "sha": "<git sha>", "section": "headline",
+     "metric": "learner_frames_per_sec_per_chip_pong",
+     "value": 707462.3, "unit": "frames/s/chip",
+     "direction": "higher", "fingerprint": "<host|arch|cpuN|backend>"}
+
+The gate checks, for the NEWEST record of every (metric, fingerprint)
+group:
+
+- **pinned budgets** (`BUDGETS` below): absolute floors for the
+  load-bearing numbers, applied only when the record's fingerprint
+  matches the budget's backend (a CPU smoke run must not trip a TPU
+  floor);
+- **relative drop vs. the trailing median**: with at least
+  ``--min-prior`` earlier records in the same group, the newest value
+  must not sit more than ``--drop`` below (above, for lower-is-better
+  metrics) the median of the trailing ``--window`` records.
+
+Exit codes mirror impala-lint: 0 clean, 1 regression found, 2
+usage/framework error (including a missing or empty history file).
+Grouping by machine fingerprint means laptops, CI boxes, and the
+tunnelled v5e each gate against their own trajectory — values are never
+compared across machines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import statistics
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+
+# Absolute floors for the load-bearing full-bench numbers (frames/s/chip
+# on the tunnelled v5e; see BENCH_live.json for the current values).
+# `fingerprint_contains` scopes each floor to the backend it was pinned
+# on — tiny CPU-CI records use their own `tiny_*` metric names and are
+# gated by the relative-drop check only.
+BUDGETS: Dict[str, Dict[str, Any]] = {
+    "learner_frames_per_sec_per_chip_pong": {
+        "min": 500_000.0,
+        "fingerprint_contains": "tpu",
+    },
+    "anakin_cartpole_frames_per_sec": {
+        "min": 1_000_000.0,
+        "fingerprint_contains": "tpu",
+    },
+}
+
+
+def git_sha(repo: str = REPO) -> str:
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=repo,
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:
+        return "unknown"
+
+
+def machine_fingerprint(backend: str = "") -> str:
+    """Stable-enough identity of the measuring machine: history records
+    only compare against records with an identical fingerprint."""
+    parts = [
+        platform.node() or "unknown-host",
+        platform.machine() or "unknown-arch",
+        f"cpu{os.cpu_count() or 0}",
+    ]
+    if backend:
+        parts.append(backend)
+    return "|".join(parts)
+
+
+def append_history(
+    section: str,
+    metric: str,
+    value: float,
+    *,
+    path: Optional[str] = None,
+    unit: str = "",
+    direction: str = "higher",
+    backend: str = "",
+    sha: Optional[str] = None,
+    fingerprint: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Append one record to the history file (created on first write).
+    The `BENCH_HISTORY_PATH` env var overrides the default location so
+    tests can write to a scratch file."""
+    path = path or os.environ.get("BENCH_HISTORY_PATH") or DEFAULT_HISTORY
+    record = {
+        "ts": time.time(),
+        "sha": sha if sha is not None else git_sha(),
+        "section": section,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+        "direction": direction,
+        "fingerprint": (
+            fingerprint
+            if fingerprint is not None
+            else machine_fingerprint(backend)
+        ),
+    }
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(record) + "\n")
+    return record
+
+
+def load_history(path: str) -> List[Dict[str, Any]]:
+    """Parse the JSONL history; raises FileNotFoundError when absent.
+    Unparseable or schema-less lines are skipped — a half-written tail
+    from a killed bench run must not wedge the gate."""
+    records: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if (
+                isinstance(rec, dict)
+                and "metric" in rec
+                and isinstance(rec.get("value"), (int, float))
+            ):
+                records.append(rec)
+    return records
+
+
+def check_records(
+    records: List[Dict[str, Any]],
+    *,
+    drop: float = 0.2,
+    window: int = 8,
+    min_prior: int = 3,
+    budgets: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> List[str]:
+    """The gate proper: findings (empty = pass) for the newest record of
+    every (metric, fingerprint) group, in file order."""
+    budgets = BUDGETS if budgets is None else budgets
+    groups: Dict[tuple, List[Dict[str, Any]]] = {}
+    for rec in records:
+        key = (rec["metric"], rec.get("fingerprint", ""))
+        groups.setdefault(key, []).append(rec)
+    findings: List[str] = []
+    for (metric, fingerprint), group in groups.items():
+        newest = group[-1]
+        value = float(newest["value"])
+        higher = newest.get("direction", "higher") != "lower"
+        budget = budgets.get(metric)
+        if budget is not None and budget.get(
+            "fingerprint_contains", ""
+        ) in fingerprint:
+            floor = budget.get("min")
+            ceil = budget.get("max")
+            if floor is not None and value < floor:
+                findings.append(
+                    f"{metric} [{fingerprint}]: {value:g} below pinned "
+                    f"budget min {floor:g} (sha {newest.get('sha')})"
+                )
+            if ceil is not None and value > ceil:
+                findings.append(
+                    f"{metric} [{fingerprint}]: {value:g} above pinned "
+                    f"budget max {ceil:g} (sha {newest.get('sha')})"
+                )
+        prior = [float(r["value"]) for r in group[:-1][-window:]]
+        if len(prior) < min_prior:
+            continue
+        med = statistics.median(prior)
+        if med <= 0:
+            continue
+        # >= so a drop of exactly the threshold is flagged (the
+        # acceptance bar: a seeded 20% regression must exit nonzero at
+        # the default --drop 0.2).
+        if higher and med - value >= drop * med:
+            findings.append(
+                f"{metric} [{fingerprint}]: {value:g} is "
+                f"{1.0 - value / med:.1%} below the trailing median "
+                f"{med:g} over {len(prior)} records "
+                f"(threshold {drop:.0%}, sha {newest.get('sha')})"
+            )
+        elif not higher and value - med >= drop * med:
+            findings.append(
+                f"{metric} [{fingerprint}]: {value:g} is "
+                f"{value / med - 1.0:.1%} above the trailing median "
+                f"{med:g} over {len(prior)} records "
+                f"(threshold {drop:.0%}, sha {newest.get('sha')})"
+            )
+    return findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perfgate",
+        description=(
+            "bench-history regression gate: pinned budgets + relative "
+            "drop vs. trailing median per (metric, machine) group"
+        ),
+    )
+    parser.add_argument(
+        "--history",
+        default=os.environ.get("BENCH_HISTORY_PATH") or DEFAULT_HISTORY,
+        help="BENCH_HISTORY.jsonl path (default: repo root, or "
+        "$BENCH_HISTORY_PATH)",
+    )
+    parser.add_argument(
+        "--drop",
+        type=float,
+        default=0.2,
+        help="max relative drop vs. the trailing median (default 0.2)",
+    )
+    parser.add_argument(
+        "--window",
+        type=int,
+        default=8,
+        help="trailing records per group for the median (default 8)",
+    )
+    parser.add_argument(
+        "--min-prior",
+        type=int,
+        default=3,
+        help="priors required before the relative check arms (default 3)",
+    )
+    parser.add_argument(
+        "--verbose", action="store_true", help="print every group checked"
+    )
+    args = parser.parse_args(argv)
+    if args.drop <= 0 or args.drop >= 1:
+        print(
+            f"perfgate: error: --drop must be in (0, 1), got {args.drop}",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        records = load_history(args.history)
+    except FileNotFoundError:
+        print(
+            f"perfgate: error: no history at {args.history} — run "
+            "bench.py (or the tiny variants) to create it",
+            file=sys.stderr,
+        )
+        return 2
+    if not records:
+        print(
+            f"perfgate: error: history at {args.history} holds no "
+            "parseable records",
+            file=sys.stderr,
+        )
+        return 2
+    findings = check_records(
+        records,
+        drop=args.drop,
+        window=args.window,
+        min_prior=args.min_prior,
+    )
+    if args.verbose:
+        groups = {
+            (r["metric"], r.get("fingerprint", "")) for r in records
+        }
+        for metric, fp in sorted(groups):
+            print(f"perfgate: checked {metric} [{fp}]", file=sys.stderr)
+    for finding in findings:
+        print(f"perfgate: REGRESSION: {finding}", file=sys.stderr)
+    n = len(findings)
+    print(
+        f"perfgate: {'FAIL' if n else 'OK'} ({n} regression"
+        f"{'s' if n != 1 else ''}, {len(records)} records, "
+        f"{len({(r['metric'], r.get('fingerprint', '')) for r in records})}"
+        " groups)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,6 +191,12 @@ class ExperimentConfig:
     # itself is always on, and SIGUSR2 dumps it on demand). run.py's
     # `--trace out.json` overrides per run.
     trace_path: str = ""
+    # Performance observatory (perf/report.py): analyze the flight
+    # recorder at run end into a roofline + pipeline-attribution report
+    # (JSON at this path, human-readable .txt sibling; "" = off).
+    # run.py's `--perf-report out.json` overrides per run, and SIGUSR2
+    # also dumps a live report when enabled.
+    perf_report: str = ""
     # Parallelism: shard the learner batch over this many devices (DP);
     # 0 = single device. SURVEY.md §3b DP row.
     dp_devices: int = 0

@@ -499,6 +499,133 @@ def _check_lint() -> tuple[str, str]:
         return "FAIL", f"impala-lint broken:\n{traceback.format_exc()}"
 
 
+def _check_perf() -> tuple[str, str]:
+    """Performance-observatory self-check (docs/OBSERVABILITY.md): the
+    cost model must report nonzero FLOPs for a tiny jitted matmul —
+    from the backend's cost_analysis where available, else the static
+    estimator — and export the perf/* gauges; the overlap analyzer must
+    attribute a synthetic two-step trace; and perfgate must catch a
+    seeded 20% throughput regression while passing the healthy prefix
+    of the same history."""
+    import os
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from torched_impala_tpu.perf import CostModel, analyze_records
+        from torched_impala_tpu.telemetry import Registry
+
+        reg = Registry()
+        cm = CostModel(registry=reg)
+        x = jnp.ones((64, 64), jnp.float32)
+        compiled = jax.jit(lambda a: (a @ a).sum()).lower(x).compile()
+        root = cm.register_root(
+            "train_step",
+            compiled=compiled,
+            # Static fallback for backends whose cost_analysis reports
+            # nothing (CPU CI): 6 * params * frames.
+            fallback_params={"w": x},
+            frames_per_call=64,
+        )
+        if root.flops <= 0:
+            return "FAIL", (
+                "cost model reported zero FLOPs for a 64x64 matmul "
+                f"(source={root.source})"
+            )
+        cm.observe_call("train_step", 1e-3)
+        snap = reg.snapshot()
+        if snap.get("telemetry/perf/flops_per_step", 0.0) <= 0.0:
+            return "FAIL", "perf/flops_per_step gauge not exported"
+        if "telemetry/perf/mfu" not in snap:
+            return "FAIL", "perf/mfu gauge not exported"
+
+        # Overlap analyzer on a synthetic two-step trace: a feed span
+        # fills part of the inter-step gap, the second step is marked
+        # replayed via its lineage args.
+        ms = 1_000_000  # ns
+        records = [
+            (0 * ms, 10 * ms, "X", "learner/train_step", 1, {}),
+            (10 * ms, 4 * ms, "X", "learner/host_stack", 1, None),
+            (
+                16 * ms,
+                10 * ms,
+                "X",
+                "learner/train_step",
+                1,
+                # reuse_max 2: a replay RE-delivery (1 = fresh).
+                {"reuse_max": 2, "staleness": 5},
+            ),
+        ]
+        learner = analyze_records(records)["learner"]
+        if learner["steps"] != 2:
+            return "FAIL", f"analyzer saw {learner['steps']} steps, not 2"
+        if abs(learner["gaps_s"]["feed"] - 0.004) > 1e-9:
+            return "FAIL", (
+                f"feed gap {learner['gaps_s']['feed']}s != 0.004s"
+            )
+        if learner["coverage_frac"] < 0.99:
+            return "FAIL", (
+                f"compute+gaps cover {learner['coverage_frac']:.0%} of "
+                "wall-clock, expected ~100%"
+            )
+        if learner["replayed"]["steps"] != 1:
+            return "FAIL", "replayed step not attributed separately"
+
+        # perfgate: healthy history passes, a seeded 20% drop fails.
+        from tools.perfgate import (
+            append_history,
+            check_records,
+            load_history,
+        )
+
+        with tempfile.TemporaryDirectory(prefix="doctor_perf_") as td:
+            hist = os.path.join(td, "history.jsonl")
+            for v in (100.0, 101.0, 99.0, 100.0):
+                append_history(
+                    "doctor",
+                    "probe_fps",
+                    v,
+                    path=hist,
+                    sha="doctor",
+                    fingerprint="doctor-host",
+                )
+            healthy = check_records(load_history(hist))
+            if healthy:
+                return "FAIL", (
+                    f"perfgate flagged a healthy history: {healthy[0]}"
+                )
+            append_history(
+                "doctor",
+                "probe_fps",
+                80.0,  # 20% below the trailing median of 100
+                path=hist,
+                sha="doctor",
+                fingerprint="doctor-host",
+            )
+            seeded = check_records(load_history(hist))
+            if not seeded:
+                return "FAIL", (
+                    "perfgate missed a seeded 20% throughput regression"
+                )
+        return "ok", (
+            f"flops={root.flops:.0f} ({root.source}); analyzer "
+            "attributes feed gap + replayed step; perfgate passes "
+            "healthy history, catches seeded -20%"
+        )
+    except Exception:
+        return "FAIL", (
+            f"performance observatory broken:\n{traceback.format_exc()}"
+        )
+
+
 def _check_serving(seed: int = 0) -> tuple[str, str]:
     """Serving-tier self-check (docs/SERVING.md): spin up a PolicyServer
     over a fresh ParamStore, connect in-process clients, drive ONE
@@ -690,6 +817,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_lint()
     print(f"  lint       [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_perf()
+    print(f"  perf       [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

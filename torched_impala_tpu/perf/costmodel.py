@@ -1,0 +1,211 @@
+"""Cost model: FLOPs / bytes-accessed per jitted root, live MFU gauges.
+
+The ROADMAP's MFU push (Pong 0.19-0.25, deep ResNet+LSTM ~0.11, B1024
+0.28) has so far been measured only by bench.py's offline arithmetic.
+This module makes the same numbers a LIVE observable: every jitted root
+(train_step, replay step, serving wave, fused K-step) registers its
+compiled cost here, and the learner's step cadence turns them into
+`perf/mfu`, `perf/membw_util`, and `perf/flops_per_step` gauges through
+the ordinary telemetry registry.
+
+Two sources, in preference order:
+
+- ``cost_analysis`` — XLA's algebraic per-program count, read off a
+  compiled executable (``jax.jit(f).lower(...).compile()`` or an AOT
+  handle). Caveat inherited from bench.py: XLA counts every
+  `lax.scan`/`while` BODY once, not x trip count, so grad-accum
+  programs under-count by ~accum (pass ``steps_per_call``/``flops_scale``
+  to correct) while a fused-K body IS one full SGD step already.
+- ``static`` — the classic dense-training estimate
+  ``6 * params * frames`` (2 forward + 4 backward) when the backend
+  reports nothing (CPU CI). Order-of-magnitude only for conv nets
+  (convs reuse params), but it keeps the gauges and the doctor
+  self-check alive off-TPU.
+
+Peak constants default to the repo-wide v5e numbers (197 TFLOP/s bf16,
+819 GB/s HBM) — the same 197e12 denominator bench.py and
+docs/SCALING.md already use, so live MFU and bench MFU are the same
+unit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
+
+# TPU v5e (v5 lite): bf16 peak and HBM bandwidth per chip. Overridable
+# per CostModel for other backends; MFU on CPU is not meaningful but the
+# flops gauge still is.
+PEAK_FLOPS_BF16 = 197e12
+PEAK_HBM_BYTES_PER_S = 819e9
+
+
+@dataclasses.dataclass
+class RootCost:
+    """Per-compiled-program cost: one entry per jitted root."""
+
+    name: str
+    flops: float = 0.0  # per CALL, after flops_scale correction
+    bytes_accessed: float = 0.0
+    temp_bytes: int = 0
+    steps_per_call: int = 1  # fused K: SGD steps per dispatch
+    frames_per_call: int = 0  # env frames consumed per dispatch
+    source: str = "none"  # "cost_analysis" | "static" | "none"
+
+
+def extract_compiled_cost(compiled: Any) -> Dict[str, float]:
+    """FLOPs / bytes-accessed / temp HBM from a compiled executable.
+
+    Handles the two shapes ``cost_analysis()`` has shipped as (a dict,
+    or a list/tuple of one dict) and returns zeros — never raises — when
+    the backend reports nothing (CPU CI).
+    """
+    out = {"flops": 0.0, "bytes_accessed": 0.0, "temp_bytes": 0.0}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        out["flops"] = max(float(cost.get("flops", 0.0)), 0.0)
+        out["bytes_accessed"] = max(
+            float(cost.get("bytes accessed", 0.0)), 0.0
+        )
+    except Exception:
+        pass
+    try:
+        out["temp_bytes"] = float(
+            compiled.memory_analysis().temp_size_in_bytes
+        )
+    except Exception:
+        pass
+    return out
+
+
+def static_flops_estimate(param_count: int, frames: int) -> float:
+    """Dense-training fallback: 6 FLOPs per parameter per frame
+    (2 forward + 4 backward). Used when cost_analysis reports nothing."""
+    return 6.0 * float(param_count) * float(frames)
+
+
+def param_count(params: Any) -> int:
+    """Total scalar count of a params pytree (jax is imported lazily so
+    report-side tooling can load this module without a backend)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "size", 0)) for leaf in jax.tree.leaves(params)
+    )
+
+
+class CostModel:
+    """Registry of jitted-root costs + the live `perf/*` gauges.
+
+    Usage::
+
+        cm = CostModel()
+        cm.register_root("train_step", compiled=executable,
+                         frames_per_call=T * B * K, steps_per_call=K)
+        # ... each learner step:
+        cm.observe_call("train_step", dt_seconds)
+
+    ``observe_call`` folds the root's per-call FLOPs and bytes over the
+    measured wall-clock into `perf/mfu` / `perf/membw_util`;
+    `perf/flops_per_step` carries the per-SGD-step FLOP count of the
+    most recently registered root.
+    """
+
+    def __init__(
+        self,
+        *,
+        peak_flops: float = PEAK_FLOPS_BF16,
+        peak_bytes_per_s: float = PEAK_HBM_BYTES_PER_S,
+        registry: Optional[Registry] = None,
+    ):
+        reg = registry if registry is not None else get_registry()
+        self.peak_flops = peak_flops
+        self.peak_bytes_per_s = peak_bytes_per_s
+        self.roots: Dict[str, RootCost] = {}
+        self._g_mfu = reg.gauge("perf/mfu")
+        self._g_membw = reg.gauge("perf/membw_util")
+        self._g_flops = reg.gauge("perf/flops_per_step")
+
+    def register_root(
+        self,
+        name: str,
+        *,
+        compiled: Any = None,
+        fallback_params: Any = None,
+        frames_per_call: int = 0,
+        steps_per_call: int = 1,
+        flops_scale: float = 1.0,
+    ) -> RootCost:
+        """Record one jitted root's cost. Prefers ``compiled``'s
+        cost_analysis; falls back to the static estimate from
+        ``fallback_params`` x ``frames_per_call``. ``flops_scale``
+        corrects scan-body-counted-once programs (grad_accum)."""
+        root = RootCost(
+            name=name,
+            steps_per_call=max(int(steps_per_call), 1),
+            frames_per_call=int(frames_per_call),
+        )
+        if compiled is not None:
+            c = extract_compiled_cost(compiled)
+            if c["flops"] > 0:
+                root.flops = c["flops"] * flops_scale
+                root.bytes_accessed = c["bytes_accessed"] * flops_scale
+                root.temp_bytes = int(c["temp_bytes"])
+                root.source = "cost_analysis"
+        if root.flops <= 0 and fallback_params is not None:
+            root.flops = static_flops_estimate(
+                param_count(fallback_params), max(frames_per_call, 1)
+            )
+            root.source = "static" if root.flops > 0 else "none"
+        self.roots[name] = root
+        if root.flops > 0:
+            self._g_flops.set(root.flops / root.steps_per_call)
+        return root
+
+    def observe_call(self, name: str, dt_seconds: float) -> float:
+        """One completed dispatch of root ``name`` took ``dt_seconds``;
+        update the live gauges and return the instantaneous MFU (0.0
+        when the root is unknown or costless)."""
+        root = self.roots.get(name)
+        if root is None or root.flops <= 0 or dt_seconds <= 0:
+            return 0.0
+        mfu = (root.flops / dt_seconds) / self.peak_flops
+        self._g_mfu.set(mfu)
+        if root.bytes_accessed > 0:
+            self._g_membw.set(
+                (root.bytes_accessed / dt_seconds) / self.peak_bytes_per_s
+            )
+        return mfu
+
+    def roofline(self, name: str) -> Dict[str, Any]:
+        """Roofline coordinates for one root: arithmetic intensity vs
+        the machine's ridge point, and which side it sits on."""
+        root = self.roots.get(name)
+        if root is None:
+            return {}
+        out: Dict[str, Any] = {
+            "root": name,
+            "source": root.source,
+            "flops_per_call": root.flops,
+            "flops_per_step": (
+                root.flops / root.steps_per_call if root.flops else 0.0
+            ),
+            "bytes_per_call": root.bytes_accessed,
+            "temp_bytes": root.temp_bytes,
+            "peak_flops": self.peak_flops,
+            "peak_bytes_per_s": self.peak_bytes_per_s,
+        }
+        ridge = self.peak_flops / self.peak_bytes_per_s
+        out["ridge_intensity"] = ridge
+        if root.bytes_accessed > 0 and root.flops > 0:
+            ai = root.flops / root.bytes_accessed
+            out["arithmetic_intensity"] = ai
+            out["bound"] = "compute" if ai >= ridge else "memory"
+        return out
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        return {name: self.roofline(name) for name in self.roots}

@@ -232,6 +232,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "Chrome-trace JSON at run end; load in Perfetto "
                         "(docs/OBSERVABILITY.md). SIGUSR2 dumps the "
                         "recorder on a live run regardless of this flag")
+    p.add_argument("--perf-report", default=None, metavar="OUT.json",
+                   help="performance observatory (perf/report.py): "
+                        "analyze the flight recorder at run end — "
+                        "inter-step gap attribution (feed/H2D/publish/"
+                        "compile/unattributed), fresh vs replayed "
+                        "compute, roofline from the cost model — into "
+                        "OUT.json plus a human-readable .txt sibling; "
+                        "SIGUSR2 also dumps a numbered live report")
     # Observability (telemetry/, docs/OBSERVABILITY.md). SIGUSR1 on a
     # live train run toggles a profiler capture into --trace-dir.
     p.add_argument("--telemetry-every", type=int, default=None,
@@ -280,6 +288,7 @@ def build_config(args: argparse.Namespace):
         ("transformer_dtype", "transformer_dtype"),
         ("env_id", "env_id"),
         ("trace", "trace_path"),
+        ("perf_report", "perf_report"),
     ):
         v = getattr(args, flag)
         if v is not None:
@@ -550,6 +559,13 @@ def main(argv=None) -> int:
     )
 
     capture, profile_window = make_profiler(args)
+    if cfg.perf_report:
+        # Chained after the flight-recorder handler make_profiler
+        # installed: one SIGUSR2 yields both the raw trace dump and a
+        # numbered live perf report.
+        from torched_impala_tpu.perf import install_sigusr2_report
+
+        install_sigusr2_report(cfg.perf_report)
     profile_ctx = None
     if args.profile_dir:
         profile_ctx = jax.profiler.trace(
@@ -594,6 +610,7 @@ def main(argv=None) -> int:
                 profile_window.on_step if profile_window else None
             ),
             trace_path=cfg.trace_path or None,
+            perf_report_path=cfg.perf_report or None,
         )
     finally:
         if profile_window is not None:
@@ -735,6 +752,19 @@ def run_anakin(args, cfg, agent, mesh, checkpointer) -> int:
             except Exception as e:  # noqa: BLE001 — teardown must finish
                 print(
                     f"[flight-recorder] export failed: {e!r}",
+                    file=sys.stderr,
+                )
+        if cfg.perf_report:
+            # Same caveat as the trace export: anakin's fused program
+            # emits no learner/train_step spans, so the report mostly
+            # documents that fact — but the artifact contract holds.
+            from torched_impala_tpu.perf import generate_report
+
+            try:
+                generate_report(cfg.perf_report)
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                print(
+                    f"[perf-report] generation failed: {e!r}",
                     file=sys.stderr,
                 )
         logger.close()

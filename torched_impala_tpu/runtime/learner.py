@@ -542,6 +542,10 @@ class Learner:
         self._m_steps_per_sec = reg.gauge("learner/steps_per_sec")
         self._m_param_lag = reg.gauge("learner/param_lag_frames")
         self._m_enqueue_block = reg.histogram("queue/enqueue_block_ms")
+        # Fused dispatches that ran through the chunked K<=4 fallback
+        # after a jit-boundary layout refusal (perf observatory; the
+        # companion perf/mfu gauges register lazily in _observe_perf).
+        self._m_fused_fallbacks = reg.counter("perf/fused_fallbacks")
         reg.gauge("queue/capacity").set(capacity)
         # Live depth, read lazily at snapshot time. Weakref: the global
         # registry must not keep a dead learner's queue (and its queued
@@ -676,6 +680,13 @@ class Learner:
         self._batch_formats = None
         self._auto_lock = threading.Lock()
         self._auto_jit = None
+        # Fused-dispatch layout fallback (ISSUE 10 satellite): once a
+        # K>4 superbatch hits a jit-boundary layout refusal, dispatch in
+        # chunks of this size instead of crashing (0 = fast path).
+        self._fused_fallback_k = 0
+        # Live perf/* gauges; built lazily on the first finished step so
+        # cheap Learner constructions (tests, doctor) pay nothing.
+        self._cost_model = None
         # Replay step: a SEPARATE jit program taking the target params
         # as a fourth (non-donated — reused across steps) state arg.
         # auto_layouts stays off under replay: the AOT machinery
@@ -1639,6 +1650,14 @@ class Learner:
             return self._finish_step(
                 logs, batch_version, meta, step_t0, step_t0_ns
             )
+        if self._fused_fallback_k:
+            return self._finish_step(
+                self._run_fused_chunked(arrays),
+                batch_version,
+                meta,
+                step_t0,
+                step_t0_ns,
+            )
         step = (
             self._auto_compiled
             if self._auto_compiled is not None
@@ -1652,36 +1671,54 @@ class Learner:
             # Deliberately loose match ('layout', case-insensitive, not
             # the exact JAX-internal "layouts that disagree" wording): a
             # JAX upgrade that rewords the message must degrade to the
-            # fallback below — which logs the original error — instead of
-            # turning a recoverable mismatch into a training crash
+            # fallbacks below — which log the original error — instead
+            # of turning a recoverable mismatch into a training crash
             # (ADVICE r5).
-            if (
-                self._auto_compiled is None
-                or "layout" not in str(e).lower()
+            fused_k = self._config.steps_per_dispatch
+            if "layout" not in str(e).lower() or (
+                self._auto_compiled is None and fused_k <= 4
             ):
                 raise
-            # device_put into the compiled Format came back with a
-            # layout the AOT executable refuses (shape-dependent; the
-            # plain jit relayouts inputs as needed). Fall back
-            # permanently rather than crash training.
             import logging
 
-            logging.getLogger(__name__).warning(
-                "auto_layouts: batch layout disagreed with the compiled "
-                "formats (%s); falling back to the standard train step",
-                str(e).splitlines()[0],
-            )
-            # _auto_jit=None stops the batcher's formats-put AND the
-            # recompile path (in-flight formats-laid batches still run:
-            # the plain jit relayouts any input). Under _auto_lock: the
-            # batcher's _ensure_auto_compiled re-checks _auto_jit inside
-            # the same lock, so a fallback landing mid-compile can never
-            # be clobbered by the compile's write-back (the race class
-            # impala-lint thread-safety/unguarded-attr polices).
-            with self._auto_lock:
-                self._auto_jit = None
-                self._auto_compiled = None
-                self._batch_formats = None
+            if self._auto_compiled is not None:
+                # device_put into the compiled Format came back with a
+                # layout the AOT executable refuses (shape-dependent;
+                # the plain jit relayouts inputs as needed). Fall back
+                # permanently rather than crash training.
+                logging.getLogger(__name__).warning(
+                    "auto_layouts: batch layout disagreed with the "
+                    "compiled formats (%s); falling back to the "
+                    "standard train step",
+                    str(e).splitlines()[0],
+                )
+                # _auto_jit=None stops the batcher's formats-put AND the
+                # recompile path (in-flight formats-laid batches still
+                # run: the plain jit relayouts any input). Under
+                # _auto_lock: the batcher's _ensure_auto_compiled
+                # re-checks _auto_jit inside the same lock, so a
+                # fallback landing mid-compile can never be clobbered by
+                # the compile's write-back (the race class impala-lint
+                # thread-safety/unguarded-attr polices).
+                with self._auto_lock:
+                    self._auto_jit = None
+                    self._auto_compiled = None
+                    self._batch_formats = None
+            else:
+                # Fused K>4 superbatch refused at the jit boundary (the
+                # learner_fused K8 crash class from BENCH_live): fall
+                # back permanently to chunked K<=4 dispatch through the
+                # same jitted scan body — one retrace for the chunk
+                # shape, then steady state — instead of crashing.
+                logging.getLogger(__name__).warning(
+                    "fused dispatch: K=%d superbatch layout refused at "
+                    "the jit boundary (%s); falling back to chunked "
+                    "K<=4 dispatch (perf/fused_fallbacks counts each "
+                    "chunked dispatch)",
+                    fused_k,
+                    str(e).splitlines()[0],
+                )
+                self._fused_fallback_k = 4
             # The failed call's donate_argnums may or may not have
             # consumed the state buffers depending on where validation
             # raised. Probe liveness before retrying: a retry on
@@ -1699,22 +1736,77 @@ class Learner:
                 and _alive(self._popart_state)
             ):
                 raise RuntimeError(
-                    "auto_layouts fallback: the failed step consumed "
-                    "its donated state buffers; restart from the last "
+                    "layout fallback: the failed step consumed its "
+                    "donated state buffers; restart from the last "
                     "checkpoint (this path is only reachable if the "
                     "backend validates layouts after donation)"
                 ) from e
-            self._params, self._opt_state, self._popart_state, logs = (
-                self._train_step(
-                    self._params,
-                    self._opt_state,
-                    self._popart_state,
-                    *arrays,
+            if self._fused_fallback_k:
+                logs = self._run_fused_chunked(arrays)
+            else:
+                self._params, self._opt_state, self._popart_state, logs = (
+                    self._train_step(
+                        self._params,
+                        self._opt_state,
+                        self._popart_state,
+                        *arrays,
+                    )
                 )
-            )
         return self._finish_step(
             logs, batch_version, meta, step_t0, step_t0_ns
         )
+
+    def _run_fused_chunked(self, arrays):
+        """Fused-dispatch layout fallback: run the [K, ...] superbatch
+        through `self._train_step` in leading-axis chunks of
+        `_fused_fallback_k`. The multi-step scan body is
+        shape-polymorphic over K, so the chunk size costs one retrace —
+        not a new program per step. Each chunked dispatch increments
+        perf/fused_fallbacks."""
+        chunk = self._fused_fallback_k
+        K = self._config.steps_per_dispatch
+        logs = None
+        for lo in range(0, K, chunk):
+            part = jax.tree.map(
+                lambda x, lo=lo: x[lo : lo + chunk], arrays
+            )
+            (
+                self._params,
+                self._opt_state,
+                self._popart_state,
+                logs,
+            ) = self._train_step(
+                self._params, self._opt_state, self._popart_state, *part
+            )
+        self._m_fused_fallbacks.inc()
+        return logs
+
+    def _observe_perf(self, step_dur_ns: int) -> None:
+        """Live perf/* gauges (perf/costmodel): register the train-step
+        root once — from the AOT executable's cost_analysis when the
+        AUTO-layout path compiled one, else the static params estimate
+        (CPU CI) — then fold each dispatch's wall-clock into perf/mfu
+        and perf/membw_util. After the first call this is a dict lookup
+        plus two gauge stores."""
+        if self._cost_model is None:
+            from torched_impala_tpu.perf import CostModel
+
+            cm = CostModel(registry=self._telemetry)
+            cfg = self._config
+            K = cfg.steps_per_dispatch
+            cm.register_root(
+                "train_step",
+                compiled=self._auto_compiled,
+                fallback_params=self._params,
+                frames_per_call=cfg.unroll_length * cfg.batch_size * K,
+                steps_per_call=K,
+                # cost_analysis counts scan BODIES once: the grad-accum
+                # microbatch body under-counts by ~accum, and the fused
+                # K-step body (one body == one SGD step) by ~K.
+                flops_scale=float(cfg.grad_accum * K),
+            )
+            self._cost_model = cm
+        self._cost_model.observe_call("train_step", step_dur_ns / 1e9)
 
     def _finish_step(
         self, logs, batch_version, meta, step_t0, step_t0_ns
@@ -1728,6 +1820,7 @@ class Learner:
         # device step (the pipeline re-synchronizes on the batch queue).
         step_dur_ns = time.monotonic_ns() - step_t0_ns
         self._m_train_step.observe(time.monotonic() - step_t0)
+        self._observe_perf(step_dur_ns)
         T = self._config.unroll_length
         K = self._config.steps_per_dispatch
         self.num_frames += T * self._config.batch_size * K

@@ -69,6 +69,7 @@ def train(
     stall_timeout: float = 0.0,
     on_learner_step: Optional[Callable[[int], None]] = None,
     trace_path: Optional[str] = None,
+    perf_report_path: Optional[str] = None,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -152,6 +153,12 @@ def train(
       Chrome-trace JSON when the run ends — crash- and stop-safe via
       the same finally that tears the pipeline down. Load it in
       Perfetto (docs/OBSERVABILITY.md).
+    - `perf_report_path="out.json"` runs the performance observatory
+      (perf/report.py) over the same retained events at run end:
+      inter-train_step gap attribution (feed/H2D/publish/compile/
+      unattributed), fresh vs replayed compute, and the cost model's
+      roofline — JSON plus a human-readable `.txt` sibling, written in
+      the same teardown finally.
     """
     if actor_mode not in ("thread", "process"):
         raise ValueError(f"unknown actor_mode {actor_mode!r}")
@@ -529,6 +536,26 @@ def train(
             except Exception as e:  # noqa: BLE001 — teardown must finish
                 print(
                     f"[flight-recorder] export failed: {e!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        if perf_report_path:
+            try:
+                from torched_impala_tpu.perf import generate_report
+
+                cm = getattr(learner, "_cost_model", None)
+                generate_report(
+                    perf_report_path,
+                    roofline=cm.snapshot() if cm is not None else None,
+                )
+                print(
+                    f"[perf-report] -> {perf_report_path}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                print(
+                    f"[perf-report] generation failed: {e!r}",
                     file=sys.stderr,
                     flush=True,
                 )
