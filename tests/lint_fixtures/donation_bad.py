@@ -1,0 +1,26 @@
+"""Seeded interprocedural donation-liveness violation.
+
+`Learner.train` forwards its `params` into a donate_argnums position,
+so callers' buffers die across `train()` — `run` reads `p` after.
+Parsed by tools/lint/donation.py, never imported.
+"""
+
+import jax
+
+
+class Learner:
+    def __init__(self):
+        self._step = jax.jit(self._impl, donate_argnums=(0, 1))
+
+    def _impl(self, params, opt, batch):
+        return params, opt
+
+    def train(self, params, opt, batch):
+        # params/opt are donated here; train() transfers the
+        # obligation to its callers (donates = {0, 1}).
+        params, opt = self._step(params, opt, batch)
+        return params, opt
+
+    def run(self, p, o, batch):
+        out = self.train(p, o, batch)
+        return out, p  # donated p read after the call: finding

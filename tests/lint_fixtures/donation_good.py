@@ -1,0 +1,25 @@
+"""Donation-clean: every donated argument is rebound from the result
+(the idiomatic `params, opt = train(params, opt, ...)` cycle) or never
+read again. The donation checker must stay silent.
+"""
+
+import jax
+
+
+class Learner:
+    def __init__(self):
+        self._step = jax.jit(self._impl, donate_argnums=(0, 1))
+
+    def _impl(self, params, opt, batch):
+        return params, opt
+
+    def train(self, params, opt, batch):
+        params, opt = self._step(params, opt, batch)
+        return params, opt
+
+    def run(self, p, o, batch):
+        p, o = self.train(p, o, batch)
+        return p, o  # rebound from the result: dead buffers, correct
+
+    def last_use(self, p, o, batch):
+        return self.train(p, o, batch)  # no read after: correct

@@ -1,0 +1,19 @@
+"""Seeded dtype-policy violations (stats-not-f32 direct and via a
+helper's return, cast-outside-jit-root). The module name carries no
+popart/vtrace token so half-in-accumulator-module is exercised by
+dtype_vtrace_bad.py instead. Parsed, never imported.
+"""
+
+import jax.numpy as jnp
+
+
+def halved(x):
+    # returns-half summary feeds the interprocedural stats rule; the
+    # cast itself is also outside any jit root.
+    return x.astype(jnp.bfloat16)
+
+
+def update_stats(x, mu, nu):
+    mu = halved(x)  # stats-not-f32 via 1-hop return flow
+    nu = jnp.zeros((4,), dtype=jnp.bfloat16)  # stats-not-f32 direct
+    return mu, nu

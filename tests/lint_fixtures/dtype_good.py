@@ -1,0 +1,19 @@
+"""dtype-policy clean: stats stay f32, the half cast lives inside a
+jit root. The dtype checker must stay silent.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def compute(x):
+    # half cast INSIDE the jit root: the explicit, compiled boundary.
+    h = x.astype(jnp.bfloat16)
+    return (h @ h.T).astype(jnp.float32)
+
+
+def update_stats(x, mu, nu):
+    mu = x.mean(dtype=jnp.float32)
+    nu = jnp.zeros((4,), dtype=jnp.float32)
+    return mu, nu

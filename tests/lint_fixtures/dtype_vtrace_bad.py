@@ -1,0 +1,11 @@
+"""Seeded violation: ANY half-precision dtype token inside a V-trace /
+PopArt module (the file name carries the scope) is a finding — these
+modules are f32-only by policy. Parsed, never imported.
+"""
+
+import jax.numpy as jnp
+
+
+def backward_scan(deltas):
+    acc = jnp.zeros_like(deltas, dtype=jnp.bfloat16)
+    return acc

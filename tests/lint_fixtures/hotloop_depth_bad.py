@@ -1,0 +1,14 @@
+"""Seeded transitive hot-loop violation: the host sync hides ONE call
+below the annotated loop — invisible at --hot-loop-depth 0, caught at
+depth 1. Parsed, never imported.
+"""
+
+
+class Server:
+    def _serve_loop(self):  # lint: hot-loop
+        while True:
+            self.step_once()
+
+    def step_once(self):
+        logits = self._infer()
+        return logits.block_until_ready()  # 1 call deep from the loop

@@ -1,0 +1,14 @@
+"""Transitive-hot-loop clean: the helper one call below the annotated
+loop stays async end to end. Silent at any --hot-loop-depth.
+"""
+
+
+class Server:
+    def _serve_loop(self):  # lint: hot-loop
+        while True:
+            self.step_once()
+
+    def step_once(self):
+        logits = self._infer()
+        self._out_ring.push(logits)  # stays on device, no sync
+        return logits

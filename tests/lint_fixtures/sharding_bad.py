@@ -1,0 +1,48 @@
+"""Seeded sharding-contract violations (every sharding/* rule fires).
+
+Parsed by tools/lint/sharding.py, never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def adhoc_spec(x, mesh):
+    # ad-hoc-spec (constructed outside spec_layout.py) AND
+    # undeclared-axis ('batch') AND spec-table-mismatch (no table
+    # entry puts an axis there).
+    spec = P(None, "batch")
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def bad_collective(x):
+    # undeclared-axis: collective axis argument.
+    return jax.lax.psum(x, "sequence")
+
+
+def bad_mesh(devs):
+    # undeclared-axis: typo'd Mesh axis tuple.
+    return Mesh(devs, ("data", "modle"))
+
+
+def takes_axis(q, *, axis_name):
+    # axis parameter: callers' string bindings are validated.
+    return jax.lax.all_gather(q, axis_name)
+
+
+def forwards_axis(q, ring_axis):
+    # 1-hop flow: ring_axis is an axis param because it reaches
+    # takes_axis(axis_name=...).
+    return takes_axis(q, axis_name=ring_axis)
+
+
+def bad_caller(q):
+    # undeclared-axis via the call graph, two hops from the collective.
+    return forwards_axis(q, "sequenze")
+
+
+def bad_arity(mesh):
+    # spec-arity-mismatch: 3-dim spec on a rank-2 array (also ad-hoc).
+    x = jnp.zeros((4, 8))
+    return jax.device_put(x, NamedSharding(mesh, P(None, None, "data")))

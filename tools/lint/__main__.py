@@ -2,7 +2,13 @@
 
 Exit codes: 0 clean (baselined findings allowed), 1 active findings,
 2 usage/framework error. Stale baseline entries print as warnings —
-delete them when the underlying finding is fixed.
+delete them when the underlying finding is fixed — or as exit-code-1
+errors under ``--strict-baseline`` (the CI posture: a stale entry is a
+muted rule that no longer mutes anything).
+
+``--format github`` emits ``::error file=...,line=...`` workflow
+annotations instead of the plain text lines, so findings land on the
+diff in a PR view.
 """
 
 from __future__ import annotations
@@ -19,12 +25,22 @@ from tools.lint.core import (
 )
 
 
+def _github_line(f) -> str:
+    # commas/newlines are property separators in workflow commands
+    msg = f.message.replace("\n", " ").replace(",", ";")
+    return (
+        f"::error file={f.path},line={f.line},"
+        f"title={f.rule}::{msg}"
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="impala-lint",
         description=(
             "static-analysis suite: thread-safety, jit-boundary, "
-            "shm-lifecycle, telemetry grammar (docs/STATIC_ANALYSIS.md)"
+            "shm-lifecycle, telemetry grammar, sharding contract, "
+            "donation liveness, dtype policy (docs/STATIC_ANALYSIS.md)"
         ),
     )
     parser.add_argument(
@@ -50,12 +66,41 @@ def main(argv=None) -> int:
         action="store_true",
         help="also print baselined (suppressed) findings",
     )
+    parser.add_argument(
+        "--strict-baseline",
+        action="store_true",
+        help="stale baseline entries are errors (exit 1), not warnings",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="finding output format (github = ::error annotations)",
+    )
+    parser.add_argument(
+        "--hot-loop-depth",
+        type=int,
+        default=0,
+        metavar="N",
+        help="extend '# lint: hot-loop' host-sync analysis N resolved "
+        "calls deep (default 0: annotated bodies only)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        from tools.lint import jitb, metrics, shm, threads
+        from tools.lint import (
+            donation,
+            dtypes,
+            jitb,
+            metrics,
+            sharding,
+            shm,
+            threads,
+        )
 
-        for mod in (threads, jitb, shm, metrics):
+        for mod in (
+            threads, jitb, shm, metrics, sharding, donation, dtypes
+        ):
             for rule, desc in sorted(mod.RULES.items()):
                 print(f"{rule:40s} {desc}")
         return 0
@@ -67,35 +112,49 @@ def main(argv=None) -> int:
             roots=DEFAULT_ROOTS,
             baseline_path=baseline,
             only=args.checker,
+            hot_loop_depth=args.hot_loop_depth,
         )
     except (KeyError, ValueError) as e:
         print(f"impala-lint: error: {e}", file=sys.stderr)
         return 2
 
     for f in result.findings:
-        print(f.format(), file=sys.stderr)
+        if args.format == "github":
+            print(_github_line(f))
+        else:
+            print(f.format(), file=sys.stderr)
     if args.verbose:
         for f, entry in result.suppressed:
             print(
                 f"{f.format()}  [baselined: {entry.justification}]",
                 file=sys.stderr,
             )
+    stale_fail = bool(result.stale_baseline) and args.strict_baseline
     for entry in result.stale_baseline:
-        print(
-            f"impala-lint: warning: stale baseline entry "
+        what = "error" if args.strict_baseline else "warning"
+        line = (
+            f"impala-lint: {what}: stale baseline entry "
             f"(baseline.txt:{entry.line}) {entry.rule} {entry.key} — "
-            "the finding no longer fires; delete the line",
-            file=sys.stderr,
+            "the finding no longer fires; delete the line"
         )
+        if args.format == "github" and args.strict_baseline:
+            print(
+                f"::error file=tools/lint/baseline.txt,"
+                f"line={entry.line},title=stale-baseline::"
+                f"{entry.rule} {entry.key} no longer fires"
+            )
+        else:
+            print(line, file=sys.stderr)
     n = len(result.findings)
+    status = "FAIL" if (n or stale_fail) else "OK"
     print(
-        f"impala-lint: {'FAIL' if n else 'OK'} ({n} active finding"
+        f"impala-lint: {status} ({n} active finding"
         f"{'s' if n != 1 else ''}, {len(result.suppressed)} baselined, "
         f"{len(result.stale_baseline)} stale baseline entr"
         f"{'ies' if len(result.stale_baseline) != 1 else 'y'})",
         file=sys.stderr,
     )
-    return 1 if result.findings else 0
+    return 1 if (result.findings or stale_fail) else 0
 
 
 if __name__ == "__main__":

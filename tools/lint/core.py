@@ -288,16 +288,33 @@ def apply_inline_allows(
     ]
 
 
-def checkers() -> Dict[str, Callable[[Sequence[SourceFile]], List[Finding]]]:
+def checkers(
+    hot_loop_depth: int = 0,
+) -> Dict[str, Callable[[Sequence[SourceFile]], List[Finding]]]:
     # Imported lazily so `from tools.lint.core import Finding` never
     # drags in every checker (the shim imports metrics only).
-    from tools.lint import jitb, metrics, shm, threads
+    import functools
+
+    from tools.lint import (
+        donation,
+        dtypes,
+        jitb,
+        metrics,
+        sharding,
+        shm,
+        threads,
+    )
 
     return {
         "thread-safety": threads.check,
-        "jit-boundary": jitb.check,
+        "jit-boundary": functools.partial(
+            jitb.check, hot_loop_depth=hot_loop_depth
+        ),
         "shm-lifecycle": shm.check,
         "telemetry": metrics.check,
+        "sharding": sharding.check,
+        "donation": donation.check,
+        "dtype": dtypes.check,
     }
 
 
@@ -307,13 +324,14 @@ def run_all(
     roots: Sequence[str] = DEFAULT_ROOTS,
     baseline_path: Optional[str] = DEFAULT_BASELINE,
     only: Optional[Sequence[str]] = None,
+    hot_loop_depth: int = 0,
 ) -> LintResult:
     """Walk `roots` under `root`, run the checkers (all by default),
     apply the baseline. Inline ``allow(...)`` suppression is applied by
     the framework here, so checkers never reimplement it."""
     files = load_files(root, roots)
     findings = framework_findings(files)
-    table = checkers()
+    table = checkers(hot_loop_depth)
     names = list(table) if only is None else list(only)
     for name in names:
         if name not in table:

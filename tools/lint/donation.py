@@ -1,0 +1,207 @@
+"""donation checker: interprocedural donate_argnums liveness.
+
+PR 7's ``jit-boundary/donated-arg-alive`` checks the call sites of a
+jitted-with-donation callable within one function. This checker lifts
+the rule across the call graph (tools/lint/ipa.py): a function that
+passes its own parameter into a donated position *transfers the
+donation obligation to its callers* — the caller's buffer is gone after
+the call, even though the caller never touches ``jax.jit`` itself.
+
+Summary computed per function (1-2 hops of propagation):
+
+    donates(f) = positional-parameter indices of f whose argument
+                 buffer is donated when f is called
+
+Base facts come from jitb's scope analysis (``self._train_step =
+jax.jit(fn, donate_argnums=(0, 1))`` and friends); each propagation
+round then adds parameters forwarded into an already-donating position.
+At every resolved call site of a donating function, the argument bound
+to a donated parameter must be DEAD afterwards — rebound by the call's
+result, or never read again in the caller (same lexical liveness
+approximation as the intra-function rule).
+
+The intra-function rule and this one never double-report: jitb fires on
+calls to the jitted callable itself, this checker on calls to the
+(transitively) donating *wrappers* resolved through the graph.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.lint import ipa
+from tools.lint.core import Finding, SourceFile
+from tools.lint.jitb import (
+    _collect_scope,
+    _flat_target_exprs,
+    _reads_after,
+    _resolve_candidates,
+    _sym,
+)
+
+RULES = {
+    "donation/donated-arg-alive": (
+        "argument reaches a donate_argnums position through the call "
+        "graph and is read again after the call"
+    ),
+}
+
+
+def _scope_donated(sf: SourceFile) -> Dict[str, Dict[str, Tuple[int, ...]]]:
+    """class-name ('' = module) -> {callable name: donated positions}
+    per file, via jitb's scope collection."""
+    out: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    if sf.tree is None:
+        return out
+    out[""] = dict(_collect_scope(sf.tree.body, sf).donated)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = dict(_collect_scope(node.body, sf).donated)
+    return out
+
+
+def _base_donates(
+    graph: ipa.CallGraph,
+    donated_by_file: Dict[str, Dict[str, Dict[str, Tuple[int, ...]]]],
+) -> Dict[str, Set[int]]:
+    """Round 0: parameters a function passes directly into a jitted
+    callable's donated positions."""
+    donates: Dict[str, Set[int]] = {}
+    for fid, fi in graph.functions.items():
+        scopes = donated_by_file.get(fi.sf.rel, {})
+        table: Dict[str, Tuple[int, ...]] = dict(scopes.get("", {}))
+        if fi.class_name is not None:
+            table.update(scopes.get(fi.class_name, {}))
+        if not table:
+            continue
+        params = fi.params()
+        got: Set[int] = set()
+        local_assigns: Dict[str, List[ast.expr]] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.targets[0], ast.Name
+            ):
+                local_assigns.setdefault(node.targets[0].id, []).append(
+                    node.value
+                )
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for cand in _resolve_candidates(node.func, local_assigns):
+                positions = table.get(cand)
+                if not positions:
+                    continue
+                for pos in positions:
+                    if pos >= len(node.args):
+                        continue
+                    arg = node.args[pos]
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        got.add(params.index(arg.id))
+        if got:
+            donates[fid] = got
+    return donates
+
+
+def _propagate(
+    graph: ipa.CallGraph, donates: Dict[str, Set[int]], hops: int = 2
+) -> Dict[str, Set[int]]:
+    """Each round: a parameter forwarded (positionally or by keyword)
+    into a donating parameter of a resolved callee donates too."""
+    for _ in range(hops):
+        changed = False
+        for fid, fi in graph.functions.items():
+            params = fi.params()
+            for site in graph.calls_out.get(fid, []):
+                callee_don = donates.get(site.callee.fid)
+                if not callee_don:
+                    continue
+                callee_params = site.callee.params()
+                bound = ipa.bound_arguments(site.callee, site.node)
+                for idx in callee_don:
+                    if idx >= len(callee_params):
+                        continue
+                    expr = bound.get(callee_params[idx])
+                    if (
+                        isinstance(expr, ast.Name)
+                        and expr.id in params
+                    ):
+                        i = params.index(expr.id)
+                        if i not in donates.setdefault(fid, set()):
+                            donates[fid].add(i)
+                            changed = True
+        if not changed:
+            break
+    return donates
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    graph = ipa.build(files)
+    donated_by_file = {sf.rel: _scope_donated(sf) for sf in files}
+    donates = _propagate(graph, _base_donates(graph, donated_by_file))
+    if not donates:
+        return []
+
+    findings: List[Finding] = []
+    for fid, fi in graph.functions.items():
+        for site in graph.calls_out.get(fid, []):
+            callee_don = donates.get(site.callee.fid)
+            if not callee_don:
+                continue
+            call = site.node
+            callee_params = site.callee.params()
+            bound = ipa.bound_arguments(site.callee, call)
+            # result-rebound targets count as dead (the idiomatic
+            # params = self.step(params, ...) pattern)
+            target_syms: Set[str] = set()
+            parent_assign = _enclosing_assign(fi.node, call)
+            if parent_assign is not None:
+                target_syms = {
+                    s
+                    for s in (
+                        _sym(t)
+                        for t in _flat_target_exprs(
+                            parent_assign.targets
+                        )
+                    )
+                    if s is not None
+                }
+            for idx in sorted(callee_don):
+                if idx >= len(callee_params):
+                    continue
+                expr = bound.get(callee_params[idx])
+                if expr is None:
+                    continue
+                sym = _sym(expr)
+                if sym is None or sym in target_syms:
+                    continue
+                later = _reads_after(fi.node, sym, call.lineno)
+                if later is not None:
+                    findings.append(
+                        Finding(
+                            rule="donation/donated-arg-alive",
+                            path=fi.sf.rel,
+                            line=call.lineno,
+                            message=(
+                                f"{sym} is donated through "
+                                f"{site.callee.qualname}() (its "
+                                f"parameter "
+                                f"'{callee_params[idx]}' reaches a "
+                                "donate_argnums position) but is "
+                                f"read again at line {later} — "
+                                "rebind it from the result or pass "
+                                "a dead buffer"
+                            ),
+                            key=(
+                                f"{fi.sf.rel}::{fi.qualname}:{sym}"
+                            ),
+                        )
+                    )
+    return findings
+
+
+def _enclosing_assign(fn: ast.AST, call: ast.Call):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.value is call:
+            return node
+    return None

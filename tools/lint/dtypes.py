@@ -1,0 +1,304 @@
+"""dtype-policy checker: the bf16-compute / f32-statistics boundary.
+
+The MFU push runs torso compute in bfloat16 (configs.compute_dtype),
+but three families of state must stay float32 — half-precision there
+corrupts training slowly and invisibly (docs/OBSERVABILITY.md's bf16
+parity gate is the runtime mirror of this static rule):
+
+- **PopArt statistics** (mu / nu / sigma and their updates): the
+  running second moment ``nu`` loses the small-return tail in bf16's 8
+  mantissa bits, and the de/re-normalization of the value head
+  amplifies the error each update;
+- **V-trace accumulators**: the backward scan accumulates products of
+  per-step corrections — rounding compounds over T;
+- **optimizer moments**: Adam/RMSProp second moments underflow.
+
+Rules:
+
+- ``dtype/half-in-accumulator-module`` — any half-precision dtype
+  token (``jnp.bfloat16`` / ``float16`` / the strings) inside a PopArt
+  or V-trace module. These files are f32-only by policy; compute casts
+  happen in the models, not in the loss/statistics ops.
+- ``dtype/stats-not-f32`` — an assignment to a statistics-named
+  binding (mu/nu/sigma/variance/moment/...) whose value is cast to or
+  created in half precision — directly, or (interprocedurally, 1-2
+  hops over tools/lint/ipa.py's call graph) via a call to a function
+  whose returns are half-precision.
+- ``dtype/cast-outside-jit-root`` — an explicit half cast
+  (``.astype(jnp.bfloat16)`` / ``dtype=jnp.bfloat16`` array creation)
+  in runtime/ops code OUTSIDE any jit-traced function. The policy is
+  that precision boundaries live inside the compiled program where the
+  parity gate can see them; host-side casts hide the boundary (and buy
+  nothing — the host copy is f32-sized anyway). Deliberate host casts
+  (e.g. the serving cache) carry an inline ``allow``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from tools.lint import ipa
+from tools.lint.core import Finding, SourceFile
+from tools.lint.jitb import _collect_scope, _traced_functions
+
+RULES = {
+    "dtype/half-in-accumulator-module": (
+        "half-precision dtype in a PopArt/V-trace module (f32-only by "
+        "policy)"
+    ),
+    "dtype/stats-not-f32": (
+        "statistics binding (PopArt stats / optimizer moment) created "
+        "or cast in half precision"
+    ),
+    "dtype/cast-outside-jit-root": (
+        "half-precision cast outside any jit root (the bf16 boundary "
+        "belongs inside the compiled program)"
+    ),
+}
+
+_HALF_NAMES = {"bfloat16", "float16", "half"}
+_ACCUM_MODULE = re.compile(r"(popart|vtrace)", re.IGNORECASE)
+_STAT_NAME = re.compile(
+    r"^(mu|nu|sigma|var|variance|mean|second_moment|first_moment"
+    r"|m1|m2|moments?)$"
+)
+# Path scope for the cast-outside-jit rule: the runtime and ops layers
+# (models legitimately cast per compute_dtype; serving casts are policy
+# and carry allows; fixtures are scanned standalone so their rel has no
+# directory prefix and matches via the fixture clause).
+_CAST_SCOPE = re.compile(r"(^|/)(runtime|ops)/|^dtype_[a-z_]+\.py$")
+
+
+def _is_half(node: ast.expr) -> bool:
+    """jnp.bfloat16 / np.float16 / 'bfloat16' / bare bfloat16."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in _HALF_NAMES
+    if isinstance(node, ast.Name):
+        return node.id in _HALF_NAMES
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _HALF_NAMES
+    return False
+
+
+def _half_token_lines(sf: SourceFile) -> List[int]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if _is_half(node) and hasattr(node, "lineno"):
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
+def _call_makes_half(call: ast.Call) -> bool:
+    """x.astype(<half>) or jnp.zeros(..., dtype=<half>) etc."""
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "astype"
+        and call.args
+        and _is_half(call.args[0])
+    ):
+        return True
+    for kw in call.keywords:
+        if kw.arg == "dtype" and _is_half(kw.value):
+            return True
+    return False
+
+
+def _returns_half(fi: ipa.FunctionInfo) -> bool:
+    """Function whose return value is (or contains, for a top-level
+    tuple) a half-cast/creation — the 0-hop summary."""
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        vals = (
+            list(node.value.elts)
+            if isinstance(node.value, ast.Tuple)
+            else [node.value]
+        )
+        for v in vals:
+            if isinstance(v, ast.Call) and _call_makes_half(v):
+                return True
+    return False
+
+
+def _half_returners(graph: ipa.CallGraph, hops: int = 2) -> Set[str]:
+    out = {
+        fid
+        for fid, fi in graph.functions.items()
+        if _returns_half(fi)
+    }
+    for _ in range(hops):
+        changed = False
+        for fid, fi in graph.functions.items():
+            if fid in out:
+                continue
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                if isinstance(node.value, ast.Call):
+                    callee = graph.resolve_call(fi, node.value)
+                    if callee is not None and callee.fid in out:
+                        out.add(fid)
+                        changed = True
+                        break
+        if not changed:
+            break
+    return out
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    graph = ipa.build(files)
+    half_ret = _half_returners(graph)
+    findings: List[Finding] = []
+
+    for sf in files:
+        if sf.tree is None:
+            continue
+        # Rule 1: f32-only modules
+        if _ACCUM_MODULE.search(sf.rel):
+            for line in _half_token_lines(sf):
+                findings.append(
+                    Finding(
+                        rule="dtype/half-in-accumulator-module",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            "half-precision dtype in a PopArt/V-trace "
+                            "module — statistics and scan accumulators "
+                            "are f32-only (cast activations in the "
+                            "model, not here)"
+                        ),
+                        key=f"{sf.rel}::half:{line}",
+                    )
+                )
+
+        # Rule 3: half casts outside jit roots (runtime/ops scope)
+        if _CAST_SCOPE.search(sf.rel):
+            _check_host_casts(sf, findings)
+
+    # Rule 2: stats bindings fed half values (direct or via the graph)
+    for fid, fi in graph.functions.items():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [
+                t.id
+                for t in node.targets
+                if isinstance(t, ast.Name)
+            ]
+            # tuple targets: mu, nu = ...
+            for t in node.targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(
+                        e.id
+                        for e in t.elts
+                        if isinstance(e, ast.Name)
+                    )
+            stat_names = [n for n in names if _STAT_NAME.match(n)]
+            if not stat_names:
+                continue
+            half_reason: Optional[str] = None
+            if isinstance(node.value, ast.Call):
+                if _call_makes_half(node.value):
+                    half_reason = "cast/created in half precision here"
+                else:
+                    callee = graph.resolve_call(fi, node.value)
+                    if callee is not None and callee.fid in half_ret:
+                        half_reason = (
+                            f"{callee.qualname}() returns a "
+                            "half-precision value"
+                        )
+            elif isinstance(node.value, ast.Attribute) or isinstance(
+                node.value, ast.Name
+            ):
+                if _is_half(node.value):
+                    half_reason = "bound to a half dtype"
+            if half_reason is not None:
+                findings.append(
+                    Finding(
+                        rule="dtype/stats-not-f32",
+                        path=fi.sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"statistics binding "
+                            f"{'/'.join(stat_names)} in "
+                            f"{fi.qualname}(): {half_reason} — "
+                            "PopArt stats, V-trace accumulators and "
+                            "optimizer moments must stay f32"
+                        ),
+                        key=(
+                            f"{fi.sf.rel}::{fi.qualname}:"
+                            f"{'/'.join(stat_names)}"
+                        ),
+                    )
+                )
+    return findings
+
+
+def _check_host_casts(sf: SourceFile, findings: List[Finding]) -> None:
+    """Half casts in functions that are not jit-traced (per file-local
+    jitb scope closure)."""
+    scopes = [("", _collect_scope(sf.tree.body, sf))]
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            scopes.append((node.name, _collect_scope(node.body, sf)))
+    traced_fns: Set[ast.AST] = set()
+    all_fns: Dict[str, ast.AST] = {}
+    for prefix, scope in scopes:
+        traced = _traced_functions(scope)
+        for name, fn in scope.functions.items():
+            qual = f"{prefix}.{name}" if prefix else name
+            all_fns[qual] = fn
+            if name in traced:
+                traced_fns.add(fn)
+                # inner defs of a traced fn are traced too
+                for sub in ast.walk(fn):
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        traced_fns.add(sub)
+    for qual, fn in all_fns.items():
+        if fn in traced_fns:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn and node in traced_fns:
+                    break
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and _call_makes_half(node)
+                and not _inside_traced(fn, node, traced_fns)
+            ):
+                findings.append(
+                    Finding(
+                        rule="dtype/cast-outside-jit-root",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"half-precision cast in {qual}() outside "
+                            "any jit root — hoist the cast into the "
+                            "jitted computation so the precision "
+                            "boundary is explicit in the compiled "
+                            "program"
+                        ),
+                        key=f"{sf.rel}::{qual}:cast:{node.lineno}",
+                    )
+                )
+
+
+def _inside_traced(
+    fn: ast.AST, node: ast.AST, traced_fns: Set[ast.AST]
+) -> bool:
+    """True when `node` sits inside a traced inner def of `fn`."""
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub is not fn
+            and sub in traced_fns
+        ):
+            for inner in ast.walk(sub):
+                if inner is node:
+                    return True
+    return False

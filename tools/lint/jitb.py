@@ -464,7 +464,54 @@ def _reads_after(
     return None
 
 
-def check(files: Sequence[SourceFile]) -> List[Finding]:
+def _transitive_hot_loop(
+    files: Sequence[SourceFile],
+    depth: int,
+    findings: List[Finding],
+) -> None:
+    """Optionally-transitive hot-loop analysis (``--hot-loop-depth N``):
+    walk N hops of resolved calls out of each ``# lint: hot-loop``
+    function (tools/lint/ipa.py call graph — cross-file, method-aware)
+    and apply the same no-host-sync rule to the callees. Off by default:
+    helpers a hot loop calls may legitimately block (ring recycling
+    waits out a transfer) — the transitive mode exists to AUDIT those
+    paths on demand, not to gate every run."""
+    from tools.lint import ipa
+
+    graph = ipa.build(files)
+    for fid, fi in graph.functions.items():
+        if not _is_hot_loop(fi.sf, fi.node):
+            continue
+        for callee, hop in graph.callees(fid, depth):
+            if _is_hot_loop(callee.sf, callee.node):
+                continue  # already checked directly
+            for node in ast.walk(callee.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _host_sync_reason(node, False)
+                if reason is None:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="jit-boundary/host-sync-in-hot-loop",
+                        path=callee.sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{reason} ({callee.qualname}() is "
+                            f"reached from hot-loop "
+                            f"{fi.qualname}(), {hop} call(s) deep)"
+                        ),
+                        key=(
+                            f"{callee.sf.rel}::{callee.qualname}:"
+                            f"{_call_label(node)}"
+                        ),
+                    )
+                )
+
+
+def check(
+    files: Sequence[SourceFile], hot_loop_depth: int = 0
+) -> List[Finding]:
     findings: List[Finding] = []
     for sf in files:
         if sf.tree is None:
@@ -531,6 +578,8 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         else f"{name}.{name2}"
                     )
                     _check_body(sf, fn2, qual, True, findings)
+    if hot_loop_depth > 0:
+        _transitive_hot_loop(files, hot_loop_depth, findings)
     # De-duplicate (an inner def can be visited via two paths).
     seen: Set[Tuple[str, int, str, str]] = set()
     unique: List[Finding] = []

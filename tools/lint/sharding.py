@@ -1,0 +1,570 @@
+"""sharding-contract checker: every axis name and PartitionSpec in the
+tree must resolve against the canonical SpecLayout table
+(torched_impala_tpu/parallel/spec_layout.py).
+
+Sharding bugs are the class static analysis catches before a TPU run
+does: a mesh-axis name that drifts between modules compiles fine and
+silently double-counts a collective; a PartitionSpec invented at a call
+site disagrees with the layout every other frame assumes. The contract:
+
+- **axes**: the only mesh-axis names are ``spec_layout.MESH_AXES``.
+  Strings bound to ``axis_name=`` kwargs, collective axis positions
+  (``psum``/``all_gather``/``ppermute``/``all_to_all``/``axis_index``/…),
+  ``Mesh(...)`` axis tuples, axis-parameter defaults, and — through the
+  call graph (tools/lint/ipa.py) — string literals bound at call sites
+  to parameters that flow into any of those one or two hops down, must
+  all be declared there.  [``sharding/undeclared-axis``]
+- **specs**: ``PartitionSpec``/``P`` is constructed in
+  spec_layout.py ONLY; everywhere else shardings come from the table's
+  builders.  [``sharding/ad-hoc-spec``]
+- **table agreement**: a literal spec (in spec_layout itself, or
+  anywhere one slips through) must degrade-match a TENSOR_TABLE entry:
+  axis entries may degrade to ``None`` (the naive shard-if-divisible
+  fallback) and leading ``None`` padding is allowed (with_leading), but
+  never a different axis or order.  [``sharding/spec-table-mismatch``]
+- **arity**: a spec must not name more dimensions than the array it is
+  applied to has (tracked for locally-created arrays of known rank).
+  [``sharding/spec-arity-mismatch``]
+
+The tables are read with ``ast.literal_eval`` from the spec_layout
+source — no jax import, so the checker runs anywhere tier-1 does.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint import ipa
+from tools.lint.core import REPO, Finding, SourceFile
+
+RULES = {
+    "sharding/undeclared-axis": (
+        "mesh-axis name not declared in SpecLayout.MESH_AXES"
+    ),
+    "sharding/ad-hoc-spec": (
+        "PartitionSpec constructed outside parallel/spec_layout.py"
+    ),
+    "sharding/spec-table-mismatch": (
+        "literal PartitionSpec does not match any SpecLayout "
+        "TENSOR_TABLE entry (modulo axis->None degradation and leading "
+        "None padding)"
+    ),
+    "sharding/spec-arity-mismatch": (
+        "PartitionSpec names more dimensions than the array has"
+    ),
+    "sharding/no-spec-layout": (
+        "SpecLayout table missing or unparsable"
+    ),
+}
+
+SPEC_LAYOUT_REL = "torched_impala_tpu/parallel/spec_layout.py"
+
+# Collective -> positional index of its axis-name argument (axis_name=
+# keyword is always recognized as well).
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pswapaxes": 1,
+    "axis_index": 0,
+}
+
+_SPEC_NAMES = {"PartitionSpec", "P"}
+
+
+def _load_tables(
+    files: Sequence[SourceFile],
+) -> Tuple[Optional[Tuple[str, ...]], Dict[str, tuple], List[Finding]]:
+    """(MESH_AXES, TENSOR_TABLE, findings). Reads the literal tables
+    from the scanned spec_layout.py, falling back to the repo's checked-
+    in copy (fixture runs scan a single file)."""
+    src = None
+    for sf in files:
+        if sf.rel == SPEC_LAYOUT_REL:
+            src = sf.text
+            break
+    if src is None:
+        path = os.path.join(REPO, SPEC_LAYOUT_REL)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+    if src is None:
+        return None, {}, [
+            Finding(
+                rule="sharding/no-spec-layout",
+                path=SPEC_LAYOUT_REL,
+                line=0,
+                message="SpecLayout module not found",
+                key=f"{SPEC_LAYOUT_REL}::missing",
+            )
+        ]
+    axes: Optional[Tuple[str, ...]] = None
+    table: Dict[str, tuple] = {}
+    try:
+        tree = ast.parse(src)
+        for stmt in tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            tgt = stmt.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id == "MESH_AXES":
+                axes = tuple(ast.literal_eval(stmt.value))
+            elif tgt.id == "TENSOR_TABLE":
+                table = {
+                    k: tuple(v)
+                    for k, v in ast.literal_eval(stmt.value).items()
+                }
+    except (SyntaxError, ValueError):
+        pass
+    if axes is None:
+        return None, {}, [
+            Finding(
+                rule="sharding/no-spec-layout",
+                path=SPEC_LAYOUT_REL,
+                line=0,
+                message=(
+                    "MESH_AXES is not a pure literal tuple "
+                    "(ast.literal_eval failed)"
+                ),
+                key=f"{SPEC_LAYOUT_REL}::literal",
+            )
+        ]
+    return axes, table, []
+
+
+def _spec_matches_table(
+    spec: Tuple[Optional[str], ...], table: Dict[str, tuple]
+) -> bool:
+    """True when `spec` is a degradation of some table entry: each
+    position equals the entry's axis or degraded to None, trailing Nones
+    dropped, up to 3 leading Nones of padding (with_leading)."""
+    s = list(spec)
+    while s and s[-1] is None:
+        s.pop()
+    if not s:
+        return True  # fully replicated matches "replicated"
+    for entry in table.values():
+        for lead in range(4):
+            cand = [None] * lead + list(entry)
+            if len(s) > len(cand):
+                continue
+            if all(
+                s[i] is None or s[i] == cand[i] for i in range(len(s))
+            ):
+                return True
+    return False
+
+
+def _str_const(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _spec_call_literal(
+    call: ast.Call,
+) -> Optional[Tuple[Optional[str], ...]]:
+    """The literal entry tuple of a P(...) call, None when any argument
+    is dynamic (a starred/Name arg) — dynamic specs are the builders'
+    business, not this rule's."""
+    out: List[Optional[str]] = []
+    for a in call.args:
+        if isinstance(a, ast.Constant):
+            if a.value is None or isinstance(a.value, str):
+                out.append(a.value)
+                continue
+        return None
+    if call.keywords:
+        return None
+    return tuple(out)
+
+
+class _FileCtx:
+    """Per-file naming context: which local names mean PartitionSpec /
+    Mesh / shard_map, resolved through the import table."""
+
+    def __init__(self, sf: SourceFile, graph: ipa.CallGraph) -> None:
+        self.sf = sf
+        self.mod = ipa.module_name(sf.rel)
+        self.imports = graph.imports.get(self.mod, {})
+
+    def is_spec_ctor(self, call: ast.Call) -> bool:
+        d = ipa.dotted(call.func)
+        if not d:
+            return False
+        last = d.split(".")[-1]
+        if last not in _SPEC_NAMES:
+            return False
+        head = d.split(".")[0]
+        if head in _SPEC_NAMES:
+            tgt = self.imports.get(head, "")
+            # `from jax.sharding import PartitionSpec [as P]` — or a
+            # fixture-local bare name (unresolvable import: assume yes)
+            return tgt.endswith("PartitionSpec") or not tgt or (
+                tgt == head
+            )
+        # jax.sharding.PartitionSpec / sharding.PartitionSpec
+        return last == "PartitionSpec"
+
+    def is_mesh_ctor(self, call: ast.Call) -> bool:
+        d = ipa.dotted(call.func)
+        return bool(d) and d.split(".")[-1] == "Mesh"
+
+
+def _validate_axis(
+    axes: Tuple[str, ...],
+    value: Optional[str],
+    sf: SourceFile,
+    line: int,
+    where: str,
+    key: str,
+    findings: List[Finding],
+) -> None:
+    if value is None or value in axes:
+        return
+    findings.append(
+        Finding(
+            rule="sharding/undeclared-axis",
+            path=sf.rel,
+            line=line,
+            message=(
+                f"axis name {value!r} ({where}) is not declared in "
+                f"SpecLayout.MESH_AXES {tuple(axes)}"
+            ),
+            key=key,
+        )
+    )
+
+
+def _axis_params_fixpoint(
+    graph: ipa.CallGraph, hops: int = 2
+) -> Dict[str, Set[str]]:
+    """fid -> parameter names that flow into an axis-name position.
+
+    Base facts: a parameter literally named ``axis_name`` or ending in
+    ``_axis`` (the tree-wide convention), or passed to a collective's
+    axis slot in the body. Then `hops` rounds of call-site propagation:
+    a parameter forwarded to a callee's axis parameter is an axis
+    parameter too."""
+    out: Dict[str, Set[str]] = {}
+    for fid, fi in graph.functions.items():
+        names = fi.all_param_names()
+        base = {
+            p for p in names if p == "axis_name" or p.endswith("_axis")
+        }
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if (
+                    kw.arg == "axis_name"
+                    and isinstance(kw.value, ast.Name)
+                    and kw.value.id in names
+                ):
+                    base.add(kw.value.id)
+            d = ipa.dotted(node.func)
+            pos = _COLLECTIVES.get(d.split(".")[-1]) if d else None
+            if pos is not None and pos < len(node.args):
+                a = node.args[pos]
+                if isinstance(a, ast.Name) and a.id in names:
+                    base.add(a.id)
+        out[fid] = base
+    for _ in range(hops):
+        changed = False
+        for fid, fi in graph.functions.items():
+            for site in graph.calls_out.get(fid, []):
+                callee_axis = out.get(site.callee.fid, set())
+                if not callee_axis:
+                    continue
+                bound = ipa.bound_arguments(site.callee, site.node)
+                for pname, expr in bound.items():
+                    if pname not in callee_axis:
+                        continue
+                    if (
+                        isinstance(expr, ast.Name)
+                        and expr.id in fi.all_param_names()
+                        and expr.id not in out[fid]
+                    ):
+                        out[fid].add(expr.id)
+                        changed = True
+        if not changed:
+            break
+    return out
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    axes, table, findings = _load_tables(files)
+    if axes is None:
+        return findings
+    graph = ipa.build(files)
+    axis_params = _axis_params_fixpoint(graph)
+
+    for sf in files:
+        if sf.tree is None or sf.rel == SPEC_LAYOUT_REL:
+            continue
+        ctx = _FileCtx(sf, graph)
+        _check_file(sf, ctx, axes, table, findings)
+
+    # spec_layout.py itself: validate the tables' self-consistency.
+    for sf in files:
+        if sf.rel != SPEC_LAYOUT_REL or sf.tree is None:
+            continue
+        for name, entry in table.items():
+            for e in entry:
+                if e is not None and e not in axes:
+                    findings.append(
+                        Finding(
+                            rule="sharding/undeclared-axis",
+                            path=sf.rel,
+                            line=1,
+                            message=(
+                                f"TENSOR_TABLE[{name!r}] names axis "
+                                f"{e!r}, not in MESH_AXES {axes}"
+                            ),
+                            key=f"{sf.rel}::table:{name}",
+                        )
+                    )
+
+    # Interprocedural: string literals bound at call sites to axis
+    # parameters of the callee (1-2 hops of flow computed above).
+    for fid, fi in graph.functions.items():
+        for site in graph.calls_out.get(fid, []):
+            callee_axis = axis_params.get(site.callee.fid, set())
+            if not callee_axis:
+                continue
+            bound = ipa.bound_arguments(site.callee, site.node)
+            for pname, expr in bound.items():
+                if pname not in callee_axis:
+                    continue
+                v = _str_const(expr)
+                if v is not None:
+                    _validate_axis(
+                        axes,
+                        v,
+                        fi.sf,
+                        expr.lineno,
+                        f"bound to {site.callee.name}({pname}=...)",
+                        f"{fi.sf.rel}::{fi.qualname}:{pname}={v}",
+                        findings,
+                    )
+        # axis-parameter string defaults
+        for pname, default in ipa.param_defaults(fi).items():
+            if pname in axis_params.get(fid, set()):
+                v = _str_const(default)
+                if v is not None:
+                    _validate_axis(
+                        axes,
+                        v,
+                        fi.sf,
+                        default.lineno,
+                        f"default of {fi.qualname}({pname})",
+                        f"{fi.sf.rel}::{fi.qualname}:default:{pname}",
+                        findings,
+                    )
+
+    # De-duplicate: the same constant can be reached as a direct
+    # axis_name= kwarg and through the call-graph binding.
+    seen: Set[Tuple[str, int, str, str]] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        ident = (f.path, f.line, f.rule, f.message)
+        if ident not in seen:
+            seen.add(ident)
+            unique.append(f)
+    return unique
+
+
+def _check_file(
+    sf: SourceFile,
+    ctx: _FileCtx,
+    axes: Tuple[str, ...],
+    table: Dict[str, tuple],
+    findings: List[Finding],
+) -> None:
+    # rank of locally-created arrays, per enclosing function body
+    ranks: Dict[Tuple[int, str], int] = {}  # (fn lineno, name) -> rank
+
+    def fn_of(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> int:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur.lineno
+            cur = parents.get(cur)
+        return 0
+
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(sf.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    _ARRAY_CTORS = {"zeros", "ones", "full", "empty", "uniform", "normal"}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.targets[0], ast.Name
+        ):
+            call = node.value
+            if isinstance(call, ast.Call):
+                d = ipa.dotted(call.func)
+                if d and d.split(".")[-1] in _ARRAY_CTORS and call.args:
+                    shape = call.args[-1] if d.split(".")[-1] in (
+                        "uniform", "normal"
+                    ) else call.args[0]
+                    if isinstance(shape, (ast.Tuple, ast.List)):
+                        ranks[
+                            (fn_of(node, parents), node.targets[0].id)
+                        ] = len(shape.elts)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # 1. PartitionSpec construction
+        if ctx.is_spec_ctor(node):
+            findings.append(
+                Finding(
+                    rule="sharding/ad-hoc-spec",
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=(
+                        "PartitionSpec constructed outside "
+                        "spec_layout.py — route through the SpecLayout "
+                        "builders (tensor_spec/batch_spec/seq_spec/...)"
+                    ),
+                    key=f"{sf.rel}::adhoc:{node.lineno}",
+                )
+            )
+            spec = _spec_call_literal(node)
+            if spec is not None:
+                for e in spec:
+                    _validate_axis(
+                        axes,
+                        e,
+                        sf,
+                        node.lineno,
+                        "in PartitionSpec literal",
+                        f"{sf.rel}::spec-axis:{e}",
+                        findings,
+                    )
+                if table and not _spec_matches_table(spec, table):
+                    findings.append(
+                        Finding(
+                            rule="sharding/spec-table-mismatch",
+                            path=sf.rel,
+                            line=node.lineno,
+                            message=(
+                                f"spec {spec!r} matches no "
+                                "TENSOR_TABLE entry (axes may degrade "
+                                "to None, never move or change)"
+                            ),
+                            key=f"{sf.rel}::mismatch:{node.lineno}",
+                        )
+                    )
+        # 2. Mesh axis tuples
+        if ctx.is_mesh_ctor(node):
+            axis_arg: Optional[ast.expr] = None
+            if len(node.args) >= 2:
+                axis_arg = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axis_arg = kw.value
+            if isinstance(axis_arg, (ast.Tuple, ast.List)):
+                for elt in axis_arg.elts:
+                    _validate_axis(
+                        axes,
+                        _str_const(elt),
+                        sf,
+                        node.lineno,
+                        "in Mesh axis_names",
+                        f"{sf.rel}::mesh-axis:{_str_const(elt)}",
+                        findings,
+                    )
+            elif axis_arg is not None:
+                v = _str_const(axis_arg)
+                if v is not None:
+                    _validate_axis(
+                        axes,
+                        v,
+                        sf,
+                        node.lineno,
+                        "in Mesh axis_names",
+                        f"{sf.rel}::mesh-axis:{v}",
+                        findings,
+                    )
+        # 3. axis_name= keyword anywhere; collective positional slots
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                v = _str_const(kw.value)
+                if v is not None:
+                    _validate_axis(
+                        axes,
+                        v,
+                        sf,
+                        node.lineno,
+                        "axis_name=",
+                        f"{sf.rel}::axis_name:{v}",
+                        findings,
+                    )
+        d = ipa.dotted(node.func)
+        pos = _COLLECTIVES.get(d.split(".")[-1]) if d else None
+        if pos is not None and pos < len(node.args):
+            v = _str_const(node.args[pos])
+            if v is not None:
+                _validate_axis(
+                    axes,
+                    v,
+                    sf,
+                    node.lineno,
+                    f"axis argument of {d.split('.')[-1]}",
+                    f"{sf.rel}::collective:{v}",
+                    findings,
+                )
+        # 4. arity: device_put / with_sharding_constraint of a known-
+        # rank local against a literal spec
+        if d and d.split(".")[-1] in (
+            "device_put",
+            "with_sharding_constraint",
+        ) and len(node.args) >= 2:
+            target, shard = node.args[0], node.args[1]
+            spec_call: Optional[ast.Call] = None
+            if isinstance(shard, ast.Call):
+                sd = ipa.dotted(shard.func)
+                if sd and sd.split(".")[-1] == "NamedSharding" and len(
+                    shard.args
+                ) >= 2 and isinstance(shard.args[1], ast.Call):
+                    spec_call = shard.args[1]
+                elif ctx.is_spec_ctor(shard):
+                    spec_call = shard
+            if (
+                spec_call is not None
+                and ctx.is_spec_ctor(spec_call)
+                and isinstance(target, ast.Name)
+            ):
+                spec = _spec_call_literal(spec_call)
+                rank = ranks.get((fn_of(node, parents), target.id))
+                if spec is not None and rank is not None:
+                    s = list(spec)
+                    while s and s[-1] is None:
+                        s.pop()
+                    if len(s) > rank:
+                        findings.append(
+                            Finding(
+                                rule="sharding/spec-arity-mismatch",
+                                path=sf.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"spec {spec!r} names "
+                                    f"{len(s)} dims but {target.id} "
+                                    f"has rank {rank}"
+                                ),
+                                key=(
+                                    f"{sf.rel}::arity:{target.id}"
+                                ),
+                            )
+                        )

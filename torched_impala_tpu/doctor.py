@@ -436,7 +436,15 @@ def _check_lint() -> tuple[str, str]:
     try:
         from tools.lint import run_all
         from tools.lint.core import SourceFile
-        from tools.lint import jitb, metrics, shm, threads
+        from tools.lint import (
+            donation,
+            dtypes,
+            jitb,
+            metrics,
+            sharding,
+            shm,
+            threads,
+        )
 
         seeded = {
             "thread-safety": (
@@ -475,6 +483,40 @@ def _check_lint() -> tuple[str, str]:
                 # exactly this.
                 'reg.counter("NoSlash")\n',  # lint: allow(telemetry)
             ),
+            # v2 interprocedural checkers: a seeded axis-name mismatch
+            # (undeclared axis reaching a collective through a call),
+            # a donated buffer leaking across a wrapper, and a PopArt
+            # stat created in bf16 via a helper.
+            "sharding": (
+                sharding,
+                "import jax\n"
+                "def g(q, *, axis_name):\n"
+                "    return jax.lax.psum(q, axis_name)\n"
+                "def caller(q):\n"
+                '    return g(q, axis_name="modle")\n',
+            ),
+            "donation": (
+                donation,
+                "import jax\n"
+                "class L:\n"
+                "    def __init__(self):\n"
+                "        self._step = jax.jit(\n"
+                "            self._impl, donate_argnums=(0,))\n"
+                "    def train(self, params):\n"
+                "        return self._step(params)\n"
+                "    def run(self, p):\n"
+                "        out = self.train(p)\n"
+                "        return out, p\n",
+            ),
+            "dtype": (
+                dtypes,
+                "import jax.numpy as jnp\n"
+                "def halved(x):\n"
+                "    return x.astype(jnp.bfloat16)\n"
+                "def update(x, mu):\n"
+                "    mu = halved(x)\n"
+                "    return mu\n",
+            ),
         }
         for name, (mod, text) in seeded.items():
             sf = SourceFile(f"<doctor-{name}>", f"doctor_{name}.py", text)
@@ -491,12 +533,73 @@ def _check_lint() -> tuple[str, str]:
                 f"first: {first.format()}"
             )
         return "ok", (
-            f"4 checkers catch their seeded violations; tree clean "
-            f"({len(result.suppressed)} baselined, "
+            f"{len(seeded)} checkers catch their seeded violations; "
+            f"tree clean ({len(result.suppressed)} baselined, "
             f"{len(result.stale_baseline)} stale)"
         )
     except Exception:
         return "FAIL", f"impala-lint broken:\n{traceback.format_exc()}"
+
+
+def _check_sharding() -> tuple[str, str]:
+    """Sharding-contract self-check (docs/STATIC_ANALYSIS.md): the
+    SpecLayout table must parse as pure literals (the static checker
+    reads it with ast.literal_eval — a computed entry blinds it), the
+    runtime mesh constants must agree with it, the sharding checker
+    must catch a seeded axis-name mismatch, and the tree itself must be
+    contract-clean."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from tools.lint import sharding as shard_check
+        from tools.lint.core import SourceFile, load_files
+
+        axes, table, errs = shard_check._load_tables([])
+        if errs or axes is None:
+            return "FAIL", (
+                "SpecLayout tables unreadable: "
+                + (errs[0].message if errs else "no MESH_AXES")
+            )
+        from torched_impala_tpu.parallel import mesh, spec_layout
+
+        if tuple(spec_layout.MESH_AXES) != axes:
+            return "FAIL", (
+                "static/runtime MESH_AXES disagree: "
+                f"{axes} vs {spec_layout.MESH_AXES}"
+            )
+        if (mesh.DATA_AXIS, mesh.MODEL_AXIS, mesh.SEQ_AXIS) != axes:
+            return "FAIL", "mesh.py axis constants drifted from table"
+        seeded = SourceFile(
+            "<doctor-sharding>",
+            "doctor_sharding.py",
+            "import jax\n"
+            "def f(x):\n"
+            '    return jax.lax.psum(x, "modle")\n',
+        )
+        if not any(
+            f.rule == "sharding/undeclared-axis"
+            for f in shard_check.check([seeded])
+        ):
+            return "FAIL", (
+                "sharding checker missed a seeded axis-name mismatch"
+            )
+        tree_findings = shard_check.check(load_files(repo))
+        if tree_findings:
+            return "FAIL", (
+                f"{len(tree_findings)} sharding-contract finding(s), "
+                f"first: {tree_findings[0].format()}"
+            )
+        return "ok", (
+            f"SpecLayout literal tables ok (axes={','.join(axes)}, "
+            f"{len(table)} logical tensors); seeded axis mismatch "
+            "caught; tree contract-clean"
+        )
+    except Exception:
+        return "FAIL", f"sharding contract broken:\n{traceback.format_exc()}"
 
 
 def _check_perf() -> tuple[str, str]:
@@ -817,6 +920,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_lint()
     print(f"  lint       [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_sharding()
+    print(f"  sharding   [{status}] {detail}")
     failed |= status == "FAIL"
     status, detail = _check_perf()
     print(f"  perf       [{status}] {detail}")

@@ -1,10 +1,14 @@
 """Mesh/sharding layer: DP over ICI, model axis reserved, sequence-parallel
-ring attention for long-context policies (SURVEY.md §3b, §6)."""
+ring attention for long-context policies (SURVEY.md §3b, §6). All
+PartitionSpecs come from the canonical SpecLayout table
+(parallel/spec_layout.py), enforced by tools/lint/sharding.py."""
 
+from torched_impala_tpu.parallel import spec_layout  # noqa: F401
 from torched_impala_tpu.parallel.mesh import (  # noqa: F401
     data_seq_mesh,
     DATA_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     batch_sharding,
     make_mesh,
     model_shardings,
@@ -27,6 +31,8 @@ __all__ = [
     "DATA_AXIS",
     "multihost",
     "MODEL_AXIS",
+    "SEQ_AXIS",
+    "spec_layout",
     "batch_sharding",
     "make_mesh",
     "model_shardings",

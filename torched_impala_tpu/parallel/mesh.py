@@ -9,6 +9,11 @@ possible without re-plumbing (size 1 for every IMPALA-scale config).
 Nothing here talks to collectives directly — shardings are declared, XLA
 inserts `psum`/`all-gather` where the program needs them (the scaling-book
 recipe: pick a mesh, annotate, let XLA do the rest).
+
+Every PartitionSpec comes from the canonical SpecLayout table
+(parallel/spec_layout.py) — this module only binds table specs to a
+concrete Mesh. The sharding-contract checker (tools/lint/sharding.py)
+enforces that split: ad-hoc `P(...)` literals here are findings.
 """
 
 from __future__ import annotations
@@ -17,10 +22,14 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
+
+from torched_impala_tpu.parallel import spec_layout
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+assert (DATA_AXIS, MODEL_AXIS, SEQ_AXIS) == spec_layout.MESH_AXES
 
 
 def make_mesh(
@@ -44,55 +53,36 @@ def make_mesh(
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    return NamedSharding(mesh, spec_layout.replicated_spec())
 
 
 def batch_sharding(mesh: Mesh, *, time_major: bool = True) -> NamedSharding:
     """Sharding for `[T, B, ...]` arrays: batch axis over `data`."""
-    if time_major:
-        return NamedSharding(mesh, P(None, DATA_AXIS))
-    return NamedSharding(mesh, P(DATA_AXIS))
+    return NamedSharding(
+        mesh, spec_layout.batch_spec(time_major=time_major)
+    )
 
 
 def state_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for `[B, ...]` recurrent-state leaves: batch over `data`."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    return NamedSharding(mesh, spec_layout.state_spec())
 
 
 def model_shardings(mesh: Mesh, tree):
     """Tensor-parallel sharding tree over the mesh's `model` axis.
 
-    Weight leaves (ndim >= 2) whose trailing (output-feature) dimension
-    divides the model-axis size shard that dimension over MODEL_AXIS —
-    Dense/conv kernels split by output features, the classic Megatron
-    column layout; biases, scalars, and indivisible leaves replicate.
-    Because optimizer-state leaves mirror their parameters' shapes, the
-    same shape rule applied to params and opt_state yields consistent
-    layouts. Correctness never depends on the choice: shardings only
-    seed the XLA partitioner, which inserts the collectives any layout
-    needs (the scaling-book recipe) — pinned against the single-device
-    step in tests/test_parallel.py. With a size-1 model axis everything
-    replicates (the DP-only layout, unchanged).
-    """
-    # Meshes without a 'model' axis at all (e.g. the ('data','seq') DP+SP
-    # mesh) replicate exactly like a size-1 model axis — caught by the
-    # full-suite DP+SP tests when this indexed unconditionally.
-    n = dict(mesh.shape).get(MODEL_AXIS, 1)
-
-    def rule(leaf):
-        shape = getattr(leaf, "shape", ())
-        if (
-            n > 1
-            and len(shape) >= 2
-            and shape[-1] % n == 0
-            and shape[-1] >= n
-        ):
-            return NamedSharding(
-                mesh, P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
-            )
-        return NamedSharding(mesh, P())
-
-    return jax.tree.map(rule, tree)
+    Delegates to the SpecLayout param-pattern map
+    (spec_layout.param_shardings): Dense/conv kernels split by output
+    features over MODEL_AXIS — the classic Megatron column layout —
+    while biases, scalars, indivisible leaves, and the LSTM gate
+    kernels replicate (the LSTM exception is a real XLA SPMD
+    miscompile; see spec_layout's docstring and
+    tests/test_parallel.py's TP+LSTM parity test). Optimizer-state
+    leaves mirror their parameters' tree paths, so the same pattern
+    map yields consistent layouts for both. With a size-1 model axis
+    (or no model axis at all — the ('data','seq') DP+SP mesh)
+    everything replicates, the DP-only layout."""
+    return spec_layout.param_shardings(mesh, tree)
 
 
 def data_seq_mesh(
@@ -117,5 +107,5 @@ def data_seq_mesh(
         )
     return Mesh(
         np.asarray(devices[:need]).reshape(num_data, num_seq),
-        ("data", "seq"),
+        (DATA_AXIS, SEQ_AXIS),
     )

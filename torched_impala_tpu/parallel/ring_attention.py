@@ -30,7 +30,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
+
+from torched_impala_tpu.parallel import spec_layout
 
 NEG_INF = -1e30
 
@@ -251,8 +253,8 @@ def _shard_over_seq(
     axis 1, prefix axis 1 — shards over it, and the ops' collectives
     still ride `axis_name` only, so each data shard runs its own
     independent seq ring. None = batch replicated (1-d seq mesh)."""
-    spec = P(axis_name, batch_axis)
-    pre_spec = P(None, batch_axis)
+    spec = spec_layout.seq_spec(axis_name, batch_axis)
+    pre_spec = spec_layout.prefix_spec(batch_axis)
     seq_args = (q, k, v) + (() if segment_ids is None else (segment_ids,))
     n_seq = len(seq_args)
     pre_args = tuple(
@@ -277,7 +279,7 @@ def _shard_over_seq(
             prefix_seg=rest[2] if has_pseg else None,
         )
 
-    sharded = jax.shard_map(
+    sharded = spec_layout.shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec,) * n_seq + (pre_spec,) * len(pre_args),

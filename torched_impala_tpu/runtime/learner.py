@@ -719,11 +719,13 @@ class Learner:
             if fused:
                 # Superbatch leaves carry a leading K axis the scan consumes;
                 # it stays unsharded (steps are sequential by construction).
-                from jax.sharding import NamedSharding, PartitionSpec
+                from jax.sharding import NamedSharding
+
+                from torched_impala_tpu.parallel import spec_layout
 
                 def _k(sh):
                     return NamedSharding(
-                        mesh, PartitionSpec(None, *tuple(sh.spec))
+                        mesh, spec_layout.with_leading(sh.spec)
                     )
 
                 bs, ss = _k(bs), _k(ss)
@@ -1182,7 +1184,10 @@ class Learner:
                         d = jax.device_put(probe)
                     else:
                         d = jax.device_put(probe, target)
-                    jax.block_until_ready(d)
+                    # One-time capability probe, memoized in
+                    # self._stack_reuse — deliberate sync, not a
+                    # per-step stall (flagged by --hot-loop-depth 1).
+                    jax.block_until_ready(d)  # lint: allow(jit-boundary/host-sync-in-hot-loop)
                     aliased |= bool(
                         np.shares_memory(np.asarray(d), probe)
                     )
