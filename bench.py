@@ -337,6 +337,10 @@ def main(args) -> None:
     # >= 3x per-request actions/s at 64 clients, shadow traffic <= 5%
     # primary-wave latency, bf16 passes the greedy parity gate).
     section("serving", lambda: run_bench_serving(jax))
+    # Host-side: closed-loop control plane (ISSUE 12 acceptance:
+    # controller-on >= static defaults on the standing-straggler pool
+    # scenario and the serving burst scenario).
+    section("control", lambda: run_bench_control(jax))
     section("e2e_components", lambda: run_e2e_components(jax))
     for mode in ("thread", "process"):
         section(f"e2e_{mode}", lambda mode=mode: run_e2e(jax, tpu_ok, mode))
@@ -2566,6 +2570,233 @@ def run_bench_serving(jax, tiny: bool = False) -> dict:
     )
     _history_append(
         "serving", {"coalesced_speedup": out["coalesced_speedup"]}, tiny=tiny
+    )
+    return out
+
+
+def run_bench_control(jax, tiny: bool = False) -> dict:
+    """Closed-loop control plane (ISSUE 12 acceptance): controller-on
+    must be no worse than the static defaults on the two standing
+    scenarios the controller was built for, and the ratios land in
+    BENCH_HISTORY.jsonl so perfgate pins them.
+
+    Scenario 1 — standing stragglers (env pool): the async ready-set
+    pool under 10% straggler injection, static ready_fraction=0.5 (the
+    historical default) vs ready_fraction="auto" (the control-plane
+    TargetMapPolicy tuner on the pool's own straggler EWMA). The auto
+    arm gets an adaptation warmup first — the tuner retunes every
+    AUTO_FRACTION_INTERVAL observed worker steps — then both arms are
+    timed on the identical workload.
+
+    Scenario 2 — serving burst: small bursts (4 clients) against a
+    server whose coalescing window is generous (under-full waves always
+    pay the whole window). The static arm keeps the configured window;
+    the controller arm runs build_serving_control's SloPolicy against
+    the request-wait p99 SLO, driven deterministically with
+    ``loop.tick(now=...)`` between bursts (no thread, no sleeps). The
+    controller shrinks the window/wave cap, so bursts stop paying the
+    full wait.
+    """
+    import numpy as np
+
+    from torched_impala_tpu import configs
+    from torched_impala_tpu.control import build_serving_control
+    from torched_impala_tpu.envs.fake import StragglerFactory
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.runtime.env_pool import ProcessEnvPool
+    from torched_impala_tpu.runtime.param_store import ParamStore
+    from torched_impala_tpu.serving import (
+        InProcessClient,
+        PolicyServer,
+        VersionRegistry,
+    )
+    from torched_impala_tpu.telemetry import FlightRecorder, Registry
+
+    # ---- scenario 1: standing stragglers in the env pool -------------
+    if tiny:
+        W, E, T, unrolls, warmup_unrolls = 4, 2, 10, 3, 3
+        straggler_delay_s = 0.025
+    else:
+        W, E, T, unrolls, warmup_unrolls = 8, 4, 20, 3, 4
+        straggler_delay_s = 0.05
+    base_delay_s, prob = 2e-3, 0.1
+    obs_dim = 8
+    inner = configs.make_env_factory(
+        configs.ExperimentConfig(
+            name="bench_control_pool",
+            env_family="cartpole",
+            obs_shape=(obs_dim,),
+            num_actions=4,
+        ),
+        fake=True,
+    )
+    agent = Agent(
+        ImpalaNet(num_actions=4, torso=MLPTorso(hidden_sizes=(64,)))
+    )
+    params = agent.init_params(
+        jax.random.key(0), np.zeros((obs_dim,), np.float32)
+    )
+    store = ParamStore()
+    store.publish(0, params)
+    try:
+        device = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        device = None
+    from torched_impala_tpu.runtime.vector_actor import VectorActor
+
+    def measure_pool(ready_fraction):
+        factory = StragglerFactory(
+            inner,
+            base_delay_s=base_delay_s,
+            straggler_delay_s=straggler_delay_s,
+            straggler_prob=prob,
+        )
+        pool = ProcessEnvPool(
+            env_factory=factory,
+            num_workers=W,
+            envs_per_worker=E,
+            obs_shape=(obs_dim,),
+            obs_dtype=np.float32,
+            mode="async",
+            ready_fraction=ready_fraction,
+        )
+        try:
+            actor = VectorActor(
+                actor_id=0,
+                envs=pool,
+                agent=agent,
+                param_store=store,
+                enqueue=lambda t: None,
+                unroll_length=T,
+                seed=0,
+                device=device,
+            )
+            # Warmup compiles the wave shapes; in auto mode it is ALSO
+            # the adaptation window the tuner converges inside.
+            n_warm = warmup_unrolls if ready_fraction == "auto" else 1
+            for _ in range(n_warm):
+                actor.unroll_and_push()
+            t0 = time.perf_counter()
+            for _ in range(unrolls):
+                actor.unroll_and_push()
+            dt = time.perf_counter() - t0
+            return (
+                unrolls * T * pool.num_envs / dt,
+                pool.ready_fraction,
+            )
+        finally:
+            pool.close()
+
+    static_sps, _ = measure_pool(0.5)
+    auto_sps, tuned_fraction = measure_pool("auto")
+    straggler = {
+        "pool": f"{W}x{E} envs, T={T}, stragglers {prob:.0%}",
+        "static_env_steps_per_sec": round(static_sps, 1),
+        "auto_env_steps_per_sec": round(auto_sps, 1),
+        "tuned_ready_fraction": round(float(tuned_fraction), 3),
+        "controller_vs_static": round(auto_sps / static_sps, 3),
+    }
+    log(f"bench: control straggler: {straggler}")
+
+    # ---- scenario 2: serving burst vs the coalescing window ----------
+    burst, cap = 4, 16
+    wait0_s = 0.010 if tiny else 0.025
+    slo_ms = 2.0
+    rounds = 12 if tiny else 40
+
+    def measure_serving(controlled: bool):
+        reg = Registry()
+        s_store = ParamStore()
+        s_store.publish(0, params)
+        registry = VersionRegistry.serving_latest(s_store, telemetry=reg)
+        server = PolicyServer(
+            agent=agent,
+            registry=registry,
+            example_obs=np.zeros((obs_dim,), np.float32),
+            max_clients=cap,
+            max_batch=cap,
+            max_wait_s=wait0_s,
+            telemetry=reg,
+        ).start()
+        loop = None
+        if controlled:
+            loop = build_serving_control(
+                server=server,
+                slo_ms=slo_ms,
+                telemetry=reg,
+                tracer=FlightRecorder(capacity=256),
+            )
+        try:
+            clients = [
+                InProcessClient(server, greedy=True)
+                for _ in range(burst)
+            ]
+            rng = np.random.default_rng(0)
+            obs = rng.normal(size=(burst, obs_dim)).astype(np.float32)
+
+            def round_trip(first):
+                cells = [
+                    c.act_async(obs[i], first)
+                    for i, c in enumerate(clients)
+                ]
+                for cell in cells:
+                    cell.result(timeout=120.0)
+
+            round_trip(True)  # warmup: compiles the wave shape
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                round_trip(False)
+                if loop is not None:
+                    # Synthetic clock strides past the policy cooldown
+                    # so every burst's evidence can move the knobs.
+                    loop.tick(now=10.0 * (r + 1))
+            dt = time.perf_counter() - t0
+            for c in clients:
+                c.close()
+        finally:
+            server.close()
+        snap = reg.snapshot()
+        return {
+            "bursts_per_sec": round(rounds / dt, 2),
+            "request_wait_ms_p99": round(
+                float(snap["telemetry/serving/request_wait_ms_p99"]), 3
+            ),
+            "final_max_wait_ms": round(server.max_wait_s * 1e3, 3),
+            "final_max_batch": int(server.max_batch),
+            "decisions": int(
+                snap.get("telemetry/control/decision_total", 0)
+            ),
+        }
+
+    static_serving = measure_serving(controlled=False)
+    controlled_serving = measure_serving(controlled=True)
+    serving = {
+        "burst": burst,
+        "rounds": rounds,
+        "configured_max_wait_ms": wait0_s * 1e3,
+        "slo_ms": slo_ms,
+        "static": static_serving,
+        "controlled": controlled_serving,
+        "controller_vs_static": round(
+            controlled_serving["bursts_per_sec"]
+            / max(static_serving["bursts_per_sec"], 1e-9),
+            3,
+        ),
+    }
+    log(f"bench: control serving: {serving}")
+
+    out = {"straggler": straggler, "serving": serving}
+    _history_append(
+        "control",
+        {
+            "straggler_controller_vs_static": straggler[
+                "controller_vs_static"
+            ],
+            "serving_controller_vs_static": serving[
+                "controller_vs_static"
+            ],
+        },
+        tiny=tiny,
     )
     return out
 

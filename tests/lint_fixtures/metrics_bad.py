@@ -14,5 +14,7 @@ reg.counter("serving/orphan_series")  # subfamily-prefix  # noqa: F821
 reg.counter("replay/orphan_series")  # subfamily-prefix (rule 3d)  # noqa: F821
 reg.counter("perf/orphan_series")  # subfamily-prefix (rule 3e)  # noqa: F821
 reg.gauge("perf/mfuzzy")  # subfamily-prefix (3e: prefix, not substring)  # noqa: F821
+reg.counter("control/orphan_series")  # subfamily-prefix (rule 3f)  # noqa: F821
+reg.gauge("control/decisions_made")  # subfamily-prefix (3f: prefix, not substring)  # noqa: F821
 rec.instant("Bad.Trace")  # trace-grammar  # noqa: F821
 rec.complete("serving/rogue_event", 0, 1)  # trace-closed-set  # noqa: F821

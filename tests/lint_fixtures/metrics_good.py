@@ -12,6 +12,11 @@ reg.gauge("replay/target_lag")  # pinned sub-family (3d)  # noqa: F821
 reg.gauge("perf/mfu")  # bare family name passes 3e  # noqa: F821
 reg.gauge("perf/membw_util")  # pinned sub-family (3e)  # noqa: F821
 reg.counter("perf/fused_fallbacks")  # pinned sub-family (3e)  # noqa: F821
+reg.counter("control/decision_total")  # pinned sub-family (3f)  # noqa: F821
+reg.counter("control/revert_total")  # pinned sub-family (3f)  # noqa: F821
+reg.gauge("control/objective_delta")  # pinned sub-family (3f)  # noqa: F821
+reg.gauge("control/knob_value")  # pinned sub-family (3f)  # noqa: F821
+rec.instant("control/decision", {"knob": "k"})  # bare family trace passes 3f  # noqa: F821
 key = "telemetry/pool/restarts"
 rec.instant("ring/commit", {"lid": "a0u0"})  # noqa: F821
 rec.complete("serving/request", 0, 1)  # pinned trace set  # noqa: F821

@@ -241,33 +241,77 @@ def test_fused_updates_sharded():
 def test_tensor_parallel_matches_single_device():
     """(2, 4) data x model mesh: the fully on-device runner with weight
     matrices Megatron-column-sharded (parallel.model_shardings, same rule
-    as the Learner) computes the same math as the single-device runner,
-    with at least one weight genuinely sharded and a checkpoint
-    roundtrip landing leaves back on their shards."""
+    as the Learner) computes the same POLICY as the single-device runner
+    — compared at the distribution level, with at least one weight
+    genuinely sharded and a checkpoint roundtrip landing leaves back on
+    their shards.
+
+    Why distributions and not losses/params (PR 11 root cause): TP's
+    column-sharded matmuls reduce in a different order, and the ~1-ulp
+    logit noise occasionally flips a categorical SAMPLE inside the fused
+    rollout; trajectories then diverge chaotically, so sampled-action
+    quantities (pg/total loss, raw param values) are NOT comparable
+    across layouts. The layout-invariant contracts are: the sharded
+    forward pass reproduces the single-device action distribution on a
+    probe batch to f32 tolerance, and the policy entropy trace stays
+    matched through training."""
     mesh = make_mesh(
         num_data=2, num_model=4, devices=jax.devices("cpu")[:8]
     )
     single = _runner(JaxCatch(), 3, E=16, T=9, seed=11)
     tp = _runner(JaxCatch(), 3, E=16, T=9, seed=11, mesh=mesh)
+
+    # Identical inits: the two runners start from byte-equal params, so
+    # any forward-parity gap below is the TP compute path itself.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        jax.tree.map(np.asarray, single.params),
+        jax.tree.map(np.asarray, tp.params),
+    )
+
+    env = JaxCatch()
+    probe_keys = jax.random.split(jax.random.key(123), 32)
+    probe_obs = np.asarray(
+        jax.vmap(lambda k: env.observe(env.reset(k)))(probe_keys)
+    )
+    agent = _agent(3)
+
+    def policy_probs(params):
+        out = agent.step(
+            params,
+            jax.random.key(0),
+            probe_obs,
+            np.ones((32,), np.bool_),
+            agent.initial_state(32),
+        )
+        logits = np.asarray(out.policy_logits, np.float64)
+        z = np.exp(logits - logits.max(-1, keepdims=True))
+        return z / z.sum(-1, keepdims=True)
+
+    # Forward parity: the Megatron-sharded forward reproduces the
+    # single-device distribution (only reduction order may differ).
+    np.testing.assert_allclose(
+        policy_probs(single.params),
+        policy_probs(tp.params),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
     for _ in range(3):
         ls = single.step()
         lt = tp.step()
-    np.testing.assert_allclose(
-        float(ls["total_loss"]), float(lt["total_loss"]), rtol=2e-4
-    )
+        np.testing.assert_allclose(
+            float(ls["entropy"]), float(lt["entropy"]), atol=1.5e-2
+        )
+
     sharded_leaves = [
         leaf
         for leaf in jax.tree.leaves(tp.params)
         if not leaf.sharding.is_fully_replicated
     ]
     assert sharded_leaves, "TP produced no sharded anakin weights"
-    jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
-        ),
-        single.params,
-        tp.params,
-    )
     state = tp.get_state()
     tp.set_state(state)
     again = [

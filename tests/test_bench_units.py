@@ -189,6 +189,32 @@ def test_serving_bench_coalescing_shadow_and_parity(jax_cpu):
     assert out["bf16_parity"], out
 
 
+def test_control_bench_controller_no_worse_than_static(jax_cpu):
+    """The ISSUE 12 acceptance bounds, wired into CI via the bench
+    control section's tiny variant: controller-on must be no worse than
+    the static defaults on both standing scenarios. The serving burst
+    is deterministic machinery (the SloPolicy shrinks a coalescing
+    window bursts otherwise always pay in full — measured 2-4x here),
+    so it pins a real win. The straggler pool scenario is timing-noisy
+    on a loaded 1-core runner, so CI keeps slack below the artifact
+    target (>= 1.0 on an idle box; 0.25 ready-fraction measured 1.85x
+    vs 0.5's 1.39x under 10% stragglers in the env_pool section)."""
+    from bench import run_bench_control
+
+    out = run_bench_control(jax_cpu, tiny=True)
+    straggler, serving = out["straggler"], out["serving"]
+    # The tuner really moved the knob off the 0.5 default toward the
+    # straggler-optimal floor, and throughput did not regress.
+    assert straggler["tuned_ready_fraction"] < 0.5, out
+    assert straggler["controller_vs_static"] >= 0.8, out
+    # The controller shrank the window below the configured value,
+    # every move was audited, and bursts sped up accordingly.
+    ctl = serving["controlled"]
+    assert ctl["decisions"] > 0, out
+    assert ctl["final_max_wait_ms"] < serving["configured_max_wait_ms"]
+    assert serving["controller_vs_static"] >= 1.2, out
+
+
 def test_perfgate_gates_tiny_bench_history(jax_cpu, tmp_path, monkeypatch):
     """The ISSUE 10 bench-history loop, end to end on CI: a tiny bench
     section appends `tiny_*` records to $BENCH_HISTORY_PATH, perfgate

@@ -239,14 +239,18 @@ class TestMetricsChecker:
         assert "NoSlash" in msgs
         assert "registered it as gauge" in msgs
         assert "Bad.Trace" in msgs
-        # rules 3b/3c/3d/3e each fire on their own family
+        # rules 3b/3c/3d/3e/3f each fire on their own family
         assert "resilience metric" in msgs
         assert "serving metric" in msgs
         assert "replay metric" in msgs
         assert "perf metric" in msgs
+        assert "control metric" in msgs
         # 3e is a PREFIX match: perf/mfuzzy fires even though it
         # contains "mfu"
         assert "perf/mfuzzy" in msgs
+        # 3f likewise: control/decisions_made fires even though it
+        # contains "decision"
+        assert "control/decisions_made" in msgs
         # prose string and malformed-charset literal must NOT flag
         assert "bad key here" not in msgs and "bad/Key" not in msgs
 

@@ -17,9 +17,9 @@ Rules (rule ids in parentheses):
 3. literal emitted keys (``"telemetry/..."`` strings,
    ``f"{PREFIX}/..."`` interpolations) carry the same grammar
    (``telemetry/literal-key``);
-3b/3c/3d/3e. ``resilience/*``, ``serving/*``, ``replay/*`` and
-   ``perf/*`` names use their pinned sub-family prefixes
-   (``telemetry/subfamily-prefix``);
+3b/3c/3d/3e/3f. ``resilience/*``, ``serving/*``, ``replay/*``,
+   ``perf/*`` and ``control/*`` names use their pinned sub-family
+   prefixes (``telemetry/subfamily-prefix``);
 4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
    ``.complete`` — follow the same slug grammar
    (``telemetry/trace-grammar``);
@@ -45,8 +45,8 @@ RULES = {
     "telemetry/type-fork": "one metric name registered as two types",
     "telemetry/literal-key": "literal emitted key violates the grammar",
     "telemetry/subfamily-prefix": (
-        "resilience/*, serving/*, replay/* or perf/* name lacks its "
-        "pinned sub-family prefix"
+        "resilience/*, serving/*, replay/*, perf/* or control/* name "
+        "lacks its pinned sub-family prefix"
     ),
     "telemetry/trace-grammar": "trace event name violates the grammar",
     "telemetry/trace-closed-set": (
@@ -83,6 +83,12 @@ REPLAY_PREFIXES = ("reuse_", "target_", "evict_", "staleness_")
 # attribution, fused-dispatch fallbacks. Checked on `<sub>_` so the
 # bare family names (perf/mfu) pass while perf/mfuzzy does not.
 PERF_PREFIXES = ("mfu_", "membw_", "flops_", "gap_", "fused_")
+# Rule 3f (control plane, ISSUE 12): the control/* family is pinned to
+# the four sub-families docs/CONTROL.md documents — decision accounting,
+# guardrail reverts, objective deltas, live knob values. Checked on
+# `<sub>_` like rule 3e so the bare `control/decision` trace event
+# passes while control/decisions_made does not.
+CONTROL_PREFIXES = ("decision_", "revert_", "objective_", "knob_")
 SERVING_TRACE_EVENTS = {
     "serving/request", "serving/wave", "serving/shadow",
 }
@@ -168,6 +174,17 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         name,
                         f"perf metric {name!r} must use a "
                         f"sub-family prefix {PERF_PREFIXES} (rule 3e)",
+                    )
+                    continue
+                if name.startswith("control/") and not (
+                    name.split("/", 1)[1] + "_"
+                ).startswith(CONTROL_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"control metric {name!r} must use a "
+                        f"sub-family prefix {CONTROL_PREFIXES} "
+                        f"(rule 3f)",
                     )
                     continue
                 prev = seen.get(name)

@@ -40,6 +40,38 @@ from torched_impala_tpu.runtime.learner import LearnerConfig
 
 
 @dataclasses.dataclass(frozen=True)
+class ControlConfig:
+    """Closed-loop control plane (torched_impala_tpu/control/,
+    docs/CONTROL.md): an online controller that tunes runtime knobs from
+    live telemetry. `mode` is "off" (default — identical behavior to
+    every run before the control plane existed) or "auto" (start a
+    ControlLoop alongside the learner, and a second one inside serving
+    eval). The remaining fields parameterize the standard policies:
+    objective-regression tolerance for the guardrail revert, hysteresis
+    band for hill climbs, post-revert/refusal cooldown, the serving p99
+    SLO budget, the checkpoint wall-clock overhead budget, and whether
+    the recompile gate may ever permit a live re-jit (default no: B/K
+    proposals are audited but refused)."""
+
+    mode: str = "off"  # "off" | "auto"
+    interval_s: float = 5.0
+    tolerance: float = 0.05
+    hysteresis: float = 0.01
+    cooldown_s: float = 30.0
+    serving_slo_ms: float = 25.0
+    checkpoint_overhead_budget: float = 0.01
+    allow_recompile: bool = False
+
+    def validate(self) -> None:
+        if self.mode not in ("off", "auto"):
+            raise ValueError(
+                f"control mode must be 'off' or 'auto', got {self.mode!r}"
+            )
+        if self.interval_s <= 0:
+            raise ValueError("control interval_s must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     """Everything needed to reproduce one experiment, statically typed."""
 
@@ -162,6 +194,9 @@ class ExperimentConfig:
     # into a same-minute stack dump.
     telemetry_interval: int = 1
     stall_timeout_s: float = 300.0
+    # Closed-loop control plane (ControlConfig above; `--control
+    # auto|off` / `--control-interval` in run.py).
+    control: ControlConfig = ControlConfig()
     # Resilience (torched_impala_tpu/resilience/, docs/RESILIENCE.md):
     # checkpoint cadence and retention, wired through `--checkpoint-
     # interval` / `--checkpoint-keep` / `--checkpoint-seconds`.

@@ -1,0 +1,63 @@
+"""Closed-loop control plane: observe telemetry, tune runtime knobs.
+
+See docs/CONTROL.md for the loop diagram, the knob table, and guardrail
+semantics. Public surface: declare knobs (`KnobSpec`/`Knob`/`KnobSet`,
+`RecompileGate`), read the system (`signals`), decide (`policies`), and
+run (`ControlLoop` + the `build_*_control` factories).
+"""
+
+from torched_impala_tpu.control.knobs import (
+    Knob,
+    KnobSet,
+    KnobSpec,
+    RecompileGate,
+)
+from torched_impala_tpu.control.loop import (
+    DECISION_EVENT,
+    ControlLoop,
+    build_serving_control,
+    build_train_control,
+)
+from torched_impala_tpu.control.policies import (
+    HillClimbPolicy,
+    Policy,
+    Proposal,
+    SloPolicy,
+    TargetMapPolicy,
+)
+from torched_impala_tpu.control.signals import (
+    CheckpointOverheadSignal,
+    EwmaSignal,
+    FnSignal,
+    GapMixSignal,
+    GaugeSignal,
+    HeadroomSignal,
+    RateSignal,
+    Signal,
+    SloHeadroomSignal,
+)
+
+__all__ = [
+    "Knob",
+    "KnobSet",
+    "KnobSpec",
+    "RecompileGate",
+    "ControlLoop",
+    "DECISION_EVENT",
+    "build_serving_control",
+    "build_train_control",
+    "HillClimbPolicy",
+    "Policy",
+    "Proposal",
+    "SloPolicy",
+    "TargetMapPolicy",
+    "CheckpointOverheadSignal",
+    "EwmaSignal",
+    "FnSignal",
+    "GapMixSignal",
+    "GaugeSignal",
+    "HeadroomSignal",
+    "RateSignal",
+    "Signal",
+    "SloHeadroomSignal",
+]

@@ -1,0 +1,251 @@
+"""Knob registry: the runtime's tunable surface, declared once.
+
+A *knob* is one runtime parameter the control plane may adjust online:
+its bounds, step granularity, settle time (how long the system needs
+before the effect of a change is judged), and a hot-apply hook that
+mutates the live object. Knobs whose change forces an XLA re-jit (batch
+size B, steps-per-dispatch K — any shape-changing parameter) are marked
+``recompile=True`` and every proposal runs through a
+:class:`RecompileGate` first: a recompile mid-run costs tens of seconds
+of learner stall, so the gate refuses unless recompiles were explicitly
+allowed AND the amortization check passes.
+
+The specs are declarative so docs/CONTROL.md's knob table, the doctor
+self-check, and tests all read the same source of truth; apply hooks are
+the ONLY mutation path the control plane has into the runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from torched_impala_tpu.telemetry import get_registry
+
+# Knob names share the telemetry slug charset: they become the
+# `control/knob_<name>` gauge and the flight-recorder decision args.
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """Declarative description of one tunable runtime parameter.
+
+    ``apply(value)`` mutates the live object (the only side effect the
+    control plane performs); ``read()`` returns the current live value —
+    both optional so specs can describe gated knobs that are never
+    actually applied (B/K today). ``step == 0`` means continuous;
+    otherwise proposals quantize to ``lo + k * step``. ``settle_s`` is
+    the window a policy must wait after an apply before judging the
+    objective (and the window within which a guardrail revert fires).
+    """
+
+    name: str
+    lo: float
+    hi: float
+    step: float = 0.0
+    settle_s: float = 0.0
+    kind: str = "float"  # "float" | "int"
+    recompile: bool = False
+    apply: Optional[Callable[[float], None]] = None
+    read: Optional[Callable[[], float]] = None
+
+    def __post_init__(self) -> None:
+        if not _NAME_RE.match(self.name):
+            raise ValueError(
+                f"knob name {self.name!r} must match {_NAME_RE.pattern} "
+                "(it becomes the control/knob_<name> gauge)"
+            )
+        if not self.lo < self.hi:
+            raise ValueError(
+                f"knob {self.name}: need lo < hi, got [{self.lo}, {self.hi}]"
+            )
+        if self.step < 0:
+            raise ValueError(f"knob {self.name}: step must be >= 0")
+        if self.kind not in ("float", "int"):
+            raise ValueError(f"knob {self.name}: kind must be float|int")
+
+    def clamp(self, value: float) -> float:
+        """Quantize to the step grid, clamp to bounds, round ints."""
+        v = float(value)
+        if self.step > 0:
+            v = self.lo + round((v - self.lo) / self.step) * self.step
+        v = min(self.hi, max(self.lo, v))
+        if self.kind == "int":
+            v = float(int(round(v)))
+        return v
+
+    def default_step(self) -> float:
+        """The move granularity a policy uses when it has no better
+        idea: the declared step, else 1/8 of the range (>= 1 for int
+        knobs so a proposal always actually moves)."""
+        s = self.step if self.step > 0 else (self.hi - self.lo) / 8.0
+        if self.kind == "int":
+            s = max(1.0, s)
+        return s
+
+
+class RecompileGate:
+    """Cost-aware gate for knobs whose change forces an XLA re-jit.
+
+    Refuses every proposal unless ``allow=True`` AND the last permitted
+    recompile is at least ``min_interval_s`` in the past — a recompile
+    costs ``cost_s`` of learner stall, so back-to-back re-jits can never
+    amortize. The train wiring keeps ``allow=False``: B/K changes are
+    *surfaced* (counted, auditable) but never taken; flipping the
+    default is a one-line config change once live re-jit is proven safe.
+    """
+
+    def __init__(
+        self,
+        *,
+        allow: bool = False,
+        cost_s: float = 30.0,
+        min_interval_s: float = 300.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.allow = allow
+        self.cost_s = cost_s
+        self.min_interval_s = min_interval_s
+        self._clock = clock
+        self._last_t: Optional[float] = None
+
+    def check(self, now: Optional[float] = None) -> Tuple[bool, str]:
+        """(permitted, reason). Does NOT record — call :meth:`record`
+        after the recompile actually happens."""
+        if not self.allow:
+            return False, (
+                "recompile-gated: live re-jit disabled "
+                f"(would stall ~{self.cost_s:.0f}s)"
+            )
+        now = self._clock() if now is None else now
+        if (
+            self._last_t is not None
+            and now - self._last_t < self.min_interval_s
+        ):
+            return False, (
+                f"recompile-gated: last re-jit {now - self._last_t:.0f}s "
+                f"ago, min interval {self.min_interval_s:.0f}s"
+            )
+        return True, "recompile permitted"
+
+    def record(self, now: Optional[float] = None) -> None:
+        self._last_t = self._clock() if now is None else now
+
+
+class Knob:
+    """One live tunable: spec + current value + the revert bookkeeping.
+
+    ``propose`` is the single entry point the control loop uses: it
+    clamps/quantizes, runs the recompile gate for gated knobs, applies
+    through the spec's hook, and remembers the previous value so a
+    guardrail :meth:`revert` can restore it. Exports the live value as
+    the ``control/knob_<name>`` gauge.
+    """
+
+    def __init__(
+        self,
+        spec: KnobSpec,
+        *,
+        gate: Optional[RecompileGate] = None,
+        initial: Optional[float] = None,
+        telemetry=None,
+    ) -> None:
+        if spec.recompile and gate is None:
+            gate = RecompileGate()  # default-deny
+        self.spec = spec
+        self.gate = gate
+        if initial is None and spec.read is None:
+            raise ValueError(
+                f"knob {spec.name}: need an initial value or a read hook"
+            )
+        self._value = spec.clamp(
+            initial if initial is not None else spec.read()
+        )
+        self._prev: Optional[float] = None
+        self.last_change_t: Optional[float] = None
+        reg = telemetry if telemetry is not None else get_registry()
+        self._m_value = reg.gauge(f"control/knob_{spec.name}")
+        self._m_value.set(self._value)
+
+    @property
+    def value(self) -> float:
+        """Current value — re-read from the live object when the spec
+        has a read hook (some other actor may have moved it)."""
+        if self.spec.read is not None:
+            live = self.spec.read()
+            if live is not None and not math.isnan(float(live)):
+                self._value = float(live)
+        return self._value
+
+    def propose(
+        self, target: float, now: Optional[float] = None
+    ) -> Tuple[str, str]:
+        """Try to move to `target`. Returns (status, detail) with status
+        one of "applied" | "noop" | "refused"."""
+        now = time.monotonic() if now is None else now
+        clamped = self.spec.clamp(target)
+        current = self.value
+        if clamped == current:
+            return "noop", f"already at {current}"
+        if self.spec.recompile:
+            ok, reason = self.gate.check(now)
+            if not ok:
+                return "refused", reason
+            self.gate.record(now)
+        self._apply(clamped, prev=current, now=now)
+        return "applied", f"{current} -> {clamped}"
+
+    def revert(self, now: Optional[float] = None) -> Optional[float]:
+        """Restore the value before the last applied change (one level —
+        the guardrail judges every change within its settle window, so
+        a deeper undo stack would never be reachable)."""
+        if self._prev is None:
+            return None
+        now = time.monotonic() if now is None else now
+        restored = self._prev
+        self._apply(restored, prev=None, now=now)
+        return restored
+
+    def _apply(
+        self, value: float, *, prev: Optional[float], now: float
+    ) -> None:
+        if self.spec.apply is not None:
+            arg = int(value) if self.spec.kind == "int" else value
+            self.spec.apply(arg)
+        self._prev = prev
+        self._value = value
+        self.last_change_t = now
+        self._m_value.set(value)
+
+
+class KnobSet:
+    """Named collection of knobs; the control loop's registry."""
+
+    def __init__(self) -> None:
+        self._knobs: Dict[str, Knob] = {}
+
+    def register(self, knob: Knob) -> Knob:
+        name = knob.spec.name
+        if name in self._knobs:
+            raise ValueError(f"knob {name!r} already registered")
+        self._knobs[name] = knob
+        return knob
+
+    def __getitem__(self, name: str) -> Knob:
+        return self._knobs[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._knobs
+
+    def __len__(self) -> int:
+        return len(self._knobs)
+
+    def names(self) -> List[str]:
+        return sorted(self._knobs)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {n: k.value for n, k in sorted(self._knobs.items())}

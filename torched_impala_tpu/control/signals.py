@@ -1,0 +1,197 @@
+"""Signal adapters: the control plane's read-only view of the system.
+
+Every policy input comes through one of these tiny adapters over the
+telemetry registry's snapshot dict (``telemetry/<component>/<name>``
+keys) — the same gauges dashboards read, so a decision is always
+explainable from the exported metrics alone. ``read`` returns ``None``
+when the signal has no data yet (missing key, NaN gauge, empty
+histogram); policies treat ``None`` as "hold, don't guess".
+
+Adapters exist for each family the controller consumes today:
+``perf/mfu`` (GaugeSignal), overlap-analyzer gap mix (GapMixSignal over
+a report provider), ``replay/staleness_frames`` + return EWMA
+(GaugeSignal / EwmaSignal), ``serving/*_ms_p99`` vs an SLO budget
+(SloHeadroomSignal), and ``resilience/checkpoint_*`` overhead
+(CheckpointOverheadSignal).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Mapping, Optional
+
+PREFIX = "telemetry"
+
+
+def _get(snap: Mapping[str, float], key: str) -> Optional[float]:
+    v = snap.get(f"{PREFIX}/{key}")
+    if v is None:
+        return None
+    v = float(v)
+    return None if math.isnan(v) else v
+
+
+class Signal:
+    """Base: ``read(snap, now)`` -> float | None."""
+
+    def read(
+        self, snap: Mapping[str, float], now: float
+    ) -> Optional[float]:
+        raise NotImplementedError
+
+
+class GaugeSignal(Signal):
+    """A registry key verbatim (``perf/mfu``, ``replay/staleness_frames``,
+    ``serving/wave_ms_p99`` — any snapshot scalar)."""
+
+    def __init__(self, key: str, *, scale: float = 1.0) -> None:
+        self.key = key
+        self.scale = scale
+
+    def read(self, snap, now):
+        v = _get(snap, self.key)
+        return None if v is None else v * self.scale
+
+
+class FnSignal(Signal):
+    """A live callable (e.g. a pool's straggler EWMA attribute) for
+    host-object state that isn't a registry gauge."""
+
+    def __init__(self, fn: Callable[[], Optional[float]]) -> None:
+        self.fn = fn
+
+    def read(self, snap, now):
+        v = self.fn()
+        if v is None:
+            return None
+        v = float(v)
+        return None if math.isnan(v) else v
+
+
+class EwmaSignal(Signal):
+    """Exponentially smoothed view of another signal — the return-trend
+    / objective smoother (a hill-climb judging raw per-tick numbers
+    would chase noise)."""
+
+    def __init__(self, inner: Signal, alpha: float = 0.25) -> None:
+        self.inner = inner
+        self.alpha = alpha
+        self._ewma: Optional[float] = None
+
+    def read(self, snap, now):
+        v = self.inner.read(snap, now)
+        if v is None:
+            return self._ewma
+        if self._ewma is None:
+            self._ewma = v
+        else:
+            a = self.alpha
+            self._ewma = (1.0 - a) * self._ewma + a * v
+        return self._ewma
+
+
+class RateSignal(Signal):
+    """Per-second rate of a monotone counter (learner steps/s,
+    checkpoint saves/s) from successive snapshots. First read primes
+    the baseline and returns None."""
+
+    def __init__(self, key: str) -> None:
+        self.key = key
+        self._last_v: Optional[float] = None
+        self._last_t: Optional[float] = None
+
+    def read(self, snap, now):
+        v = _get(snap, self.key)
+        if v is None:
+            return None
+        last_v, last_t = self._last_v, self._last_t
+        self._last_v, self._last_t = v, now
+        if last_v is None or last_t is None or now <= last_t:
+            return None
+        return (v - last_v) / (now - last_t)
+
+
+class SloHeadroomSignal(Signal):
+    """Normalized headroom of a latency percentile against an SLO
+    budget: ``(budget - p99) / budget`` — positive means under budget,
+    negative means violating, and the magnitude is comparable across
+    budgets. The serving policies' input
+    (``serving/request_wait_ms_p99`` vs ``--serving`` SLO)."""
+
+    def __init__(self, key: str, budget: float) -> None:
+        if budget <= 0:
+            raise ValueError(f"SLO budget must be > 0, got {budget}")
+        self.key = key
+        self.budget = budget
+
+    def read(self, snap, now):
+        v = _get(snap, self.key)
+        if v is None:
+            return None
+        return (self.budget - v) / self.budget
+
+
+class HeadroomSignal(Signal):
+    """Normalized headroom of a *composed* signal against a budget —
+    same semantics as :class:`SloHeadroomSignal` but over another
+    Signal instead of a raw snapshot key (e.g. checkpoint overhead
+    fraction vs its 1% budget)."""
+
+    def __init__(self, inner: Signal, budget: float) -> None:
+        if budget <= 0:
+            raise ValueError(f"budget must be > 0, got {budget}")
+        self.inner = inner
+        self.budget = budget
+
+    def read(self, snap, now):
+        v = self.inner.read(snap, now)
+        if v is None:
+            return None
+        return (self.budget - v) / self.budget
+
+
+class CheckpointOverheadSignal(Signal):
+    """Fraction of wall-clock spent writing checkpoints: the save-cost
+    EWMA (``resilience/checkpoint_save_ms``) times the measured save
+    rate. ~0.003 means 0.3% of the run is checkpointing — the cadence
+    policy holds while this sits under its budget."""
+
+    def __init__(
+        self,
+        save_ms_key: str = "resilience/checkpoint_save_ms_ms",
+        saves_key: str = "resilience/checkpoint_saves",
+    ) -> None:
+        self.save_ms = GaugeSignal(save_ms_key)
+        self.saves_rate = RateSignal(saves_key)
+
+    def read(self, snap, now):
+        ms = self.save_ms.read(snap, now)
+        rate = self.saves_rate.read(snap, now)
+        if ms is None or rate is None:
+            return None
+        return max(0.0, ms) * 1e-3 * max(0.0, rate)
+
+
+class GapMixSignal(Signal):
+    """One bucket of the overlap analyzer's inter-step gap attribution
+    (``gap_frac`` from perf/report.py — publish/h2d/feed/compile). The
+    analyzer runs over the flight recorder on demand, not as a live
+    gauge, so this adapter wraps a provider callable that returns the
+    latest report's learner dict (or None before the first report)."""
+
+    def __init__(
+        self,
+        provider: Callable[[], Optional[Mapping]],
+        bucket: str,
+    ) -> None:
+        self.provider = provider
+        self.bucket = bucket
+
+    def read(self, snap, now):
+        report = self.provider()
+        if not report:
+            return None
+        frac = report.get("gap_frac")
+        if not frac or self.bucket not in frac:
+            return None
+        return float(frac[self.bucket])

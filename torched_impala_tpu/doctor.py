@@ -729,6 +729,119 @@ def _check_perf() -> tuple[str, str]:
         )
 
 
+def _check_control() -> tuple[str, str]:
+    """Control-plane self-check (docs/CONTROL.md): a synthetic objective
+    drives one hill-climb knob up, a seeded regression forces the
+    guardrail revert, and a gated recompile knob is refused — with the
+    control/* telemetry counters AND the control/decision flight-recorder
+    events accounted exactly (2 sets + 1 revert + 1 refusal), plus the
+    post-revert cooldown holding the knob still. Deterministic: explicit
+    tick clock, private registry/recorder, no threads."""
+    try:
+        from torched_impala_tpu.control import (
+            ControlLoop,
+            FnSignal,
+            HillClimbPolicy,
+            Knob,
+            KnobSpec,
+            RecompileGate,
+            SloPolicy,
+        )
+        from torched_impala_tpu.telemetry import Registry
+        from torched_impala_tpu.telemetry.tracing import FlightRecorder
+
+        reg = Registry()
+        rec = FlightRecorder(capacity=256)
+        loop = ControlLoop(interval_s=1.0, telemetry=reg, tracer=rec)
+        state = {"k": 4, "obj": 0.5}
+        loop.bind(
+            Knob(
+                KnobSpec(
+                    "doctor_knob", lo=0, hi=8, step=1, settle_s=2.0,
+                    kind="int",
+                    apply=lambda v: state.__setitem__("k", int(v)),
+                    read=lambda: state["k"],
+                ),
+                telemetry=reg,
+            ),
+            HillClimbPolicy(
+                FnSignal(lambda: state["obj"]),
+                tolerance=0.05, hysteresis=0.01, cooldown_s=10.0,
+            ),
+        )
+        # Gated B-style knob: a policy-less surface; propose directly.
+        gated = loop.add_knob(
+            Knob(
+                KnobSpec("doctor_batch", lo=1, hi=64, step=1,
+                         kind="int", recompile=True),
+                gate=RecompileGate(allow=False),
+                initial=8,
+                telemetry=reg,
+            )
+        )
+
+        loop.tick(now=0.0)          # climb: 4 -> 5
+        if state["k"] != 5:
+            return "FAIL", f"synthetic signal did not drive knob up: {state}"
+        state["obj"] = 0.6          # the move paid off
+        loop.tick(now=3.0)          # settle elapsed: commit
+        loop.tick(now=4.0)          # climb again: 5 -> 6
+        if state["k"] != 6:
+            return "FAIL", f"second climb step missing: {state}"
+        state["obj"] = 0.3          # seeded regression (>5% of 0.6)
+        loop.tick(now=7.0)          # guardrail: revert 6 -> 5
+        if state["k"] != 5:
+            return "FAIL", f"guardrail revert did not restore knob: {state}"
+        loop.tick(now=8.0)          # inside cooldown: must hold
+        if state["k"] != 5:
+            return "FAIL", f"knob moved during post-revert cooldown: {state}"
+        # Bind the gated knob to a policy that always wants to grow it
+        # (violating SLO, grow_on_violation): one more tick must route
+        # the proposal into the recompile gate and take the refusal.
+        loop.bind(
+            gated,
+            SloPolicy(
+                FnSignal(lambda: -1.0), grow_on_violation=True
+            ),
+        )
+        loop.tick(now=9.0)          # hill-climb in cooldown; B refused
+        if state["k"] != 5:
+            return "FAIL", f"knob moved during post-revert cooldown: {state}"
+        snap = reg.snapshot()
+        expected = {
+            "telemetry/control/decision_total": 2,
+            "telemetry/control/decision_refused": 1,
+            "telemetry/control/revert_total": 1,
+            "telemetry/control/knob_doctor_knob": 5.0,
+            "telemetry/control/knob_doctor_batch": 8.0,
+        }
+        for key, want in expected.items():
+            got = snap.get(key)
+            if got != want:
+                return "FAIL", f"{key} = {got}, expected {want}"
+        decisions = [
+            r for r in rec.tail() if r[3] == "control/decision"
+        ]
+        kinds = [r[5]["kind"] for r in decisions]
+        if kinds != ["set", "set", "revert", "refused"]:
+            return "FAIL", (
+                f"decision audit trail mismatch: {kinds} != "
+                "['set', 'set', 'revert', 'refused']"
+            )
+        if decisions[2][5]["to"] != 5.0:
+            return "FAIL", (
+                f"revert event restored {decisions[2][5]['to']}, not 5"
+            )
+        return "ok", (
+            "hill-climb drove knob 4->6 on a synthetic objective, seeded "
+            "regression reverted to 5 (cooldown holds), recompile gate "
+            "refused B; 2 sets + 1 revert + 1 refusal accounted in "
+            "telemetry and the flight recorder"
+        )
+    except Exception:
+        return "FAIL", f"control plane broken:\n{traceback.format_exc()}"
+
+
 def _check_serving(seed: int = 0) -> tuple[str, str]:
     """Serving-tier self-check (docs/SERVING.md): spin up a PolicyServer
     over a fresh ParamStore, connect in-process clients, drive ONE
@@ -926,6 +1039,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_perf()
     print(f"  perf       [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_control()
+    print(f"  control    [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

@@ -253,6 +253,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "thread stacks + telemetry to stderr and emits a "
                         "telemetry/watchdog/stall event (default: "
                         "preset's stall_timeout_s, normally 300; 0 off)")
+    # Control plane (torched_impala_tpu/control/, docs/CONTROL.md).
+    p.add_argument("--control", choices=("auto", "off"), default=None,
+                   help="closed-loop control plane: 'auto' starts a "
+                        "ControlLoop that tunes runtime knobs (fused-K "
+                        "chunking, replay max_reuse, checkpoint cadence; "
+                        "serving latency knobs under --eval-serving) from "
+                        "live telemetry, with every decision audited as "
+                        "control/* telemetry and control/decision trace "
+                        "events (default: preset's control.mode, 'off')")
+    p.add_argument("--control-interval", type=float, default=None,
+                   metavar="S",
+                   help="ControlLoop tick period in seconds (default: "
+                        "preset's control.interval_s, 5.0)")
     return p.parse_args(argv)
 
 
@@ -297,7 +310,17 @@ def build_config(args: argparse.Namespace):
         overrides["remat_torso"] = True
     if args.traj_ring:
         overrides["traj_ring"] = True
+    control_overrides = {}
+    if args.control is not None:
+        control_overrides["mode"] = args.control
+    if args.control_interval is not None:
+        control_overrides["interval_s"] = args.control_interval
+    if control_overrides:
+        overrides["control"] = dataclasses.replace(
+            cfg.control, **control_overrides
+        )
     cfg = dataclasses.replace(cfg, **overrides) if overrides else cfg
+    cfg.control.validate()
     if args.env_id is not None and not args.fake_envs:
         # The preset's num_actions describes its ORIGINAL env; a
         # substituted game's action space can differ (pong 6 vs breakout
@@ -611,6 +634,7 @@ def main(argv=None) -> int:
             ),
             trace_path=cfg.trace_path or None,
             perf_report_path=cfg.perf_report or None,
+            control=cfg.control,
         )
     finally:
         if profile_window is not None:
@@ -878,6 +902,16 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
             dtype=serve_dtype,
             seed=args.seed,
         ).start()
+        control_loop = None
+        if cfg.control.mode == "auto":
+            from torched_impala_tpu.control import build_serving_control
+
+            control_loop = build_serving_control(
+                server=server,
+                slo_ms=cfg.control.serving_slo_ms,
+                interval_s=min(1.0, cfg.control.interval_s),
+            )
+            control_loop.start()
         env = env_factory(args.seed + 777_000)
         try:
             with InProcessClient(
@@ -892,6 +926,8 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
                     client=client,
                 )
         finally:
+            if control_loop is not None:
+                control_loop.stop()
             server.close()
             close = getattr(env, "close", None)
             if close is not None:

@@ -341,6 +341,35 @@ class ProcessEnvPool:
         # reads — exported so a dashboard can watch the tuner move.
         self._m_ready_fraction = reg.gauge("pool/ready_fraction")
         self._m_ready_fraction.set(self.ready_fraction)
+        # "auto" mode runs on the control-plane framework: a Knob over
+        # `ready_fraction` driven by a TargetMapPolicy on the pool's own
+        # straggler-flag EWMA (this pool was the prototype the framework
+        # generalizes — see torched_impala_tpu/control/). The pool ticks
+        # its policy itself from _observe_step: the tuner must work in
+        # bench/eval harnesses that never start a ControlLoop thread.
+        if self._auto_fraction:
+            from torched_impala_tpu.control import (
+                FnSignal,
+                Knob,
+                KnobSpec,
+                TargetMapPolicy,
+            )
+
+            self._fraction_knob = Knob(
+                KnobSpec(
+                    "pool_ready_fraction",
+                    lo=self.AUTO_FRACTION_MIN,
+                    hi=1.0,
+                    apply=self._set_ready_fraction,
+                    read=lambda: self.ready_fraction,
+                ),
+                telemetry=reg,
+            )
+            self._fraction_policy = TargetMapPolicy(
+                FnSignal(lambda: self._straggler_ewma),
+                slope=self.AUTO_FRACTION_SLOPE,
+                base=1.0,
+            )
         self._submit_t = [0.0] * num_workers
         self._step_ewma: Optional[float] = None
         # Flight recorder (telemetry/tracing.py): every parent-observed
@@ -465,12 +494,13 @@ class ProcessEnvPool:
     # emulator stalls — GC pauses, level loads — sit well above 5ms.
     STRAGGLER_FLOOR_S = 5e-3
 
-    # ready_fraction="auto" tuner constants: straggler-flag EWMA weight,
-    # retune period (observed steps), and the rate->fraction line fit to
-    # the bench.py env_pool measurements — rate 0 maps to 1.0 (full
-    # coalesced waves; parity without stragglers at every fraction) and
-    # rate 0.1 maps to the 0.25 floor (the measured 1.81x winner at 10%
-    # injected stragglers).
+    # ready_fraction="auto" tuner parameters: straggler-flag EWMA
+    # weight, retune period (observed steps), and the rate->fraction
+    # line fit to the bench.py env_pool measurements — rate 0 maps to
+    # 1.0 (full coalesced waves; parity without stragglers at every
+    # fraction) and rate 0.1 maps to the 0.25 floor (the measured 1.81x
+    # winner at 10% injected stragglers). SLOPE/MIN parameterize the
+    # control-plane TargetMapPolicy/KnobSpec built in __init__.
     AUTO_FRACTION_ALPHA = 1.0 / 32.0
     AUTO_FRACTION_INTERVAL = 32
     AUTO_FRACTION_SLOPE = 7.5
@@ -514,15 +544,23 @@ class ProcessEnvPool:
             if self._auto_obs % self.AUTO_FRACTION_INTERVAL == 0:
                 self._update_auto_fraction()
 
-    def _update_auto_fraction(self) -> None:
-        """Map the straggler-rate EWMA onto the measured rate->fraction
-        line (see the AUTO_FRACTION_* constants). Only `ready_fraction`
-        mutates — the driving actor re-reads it at each unroll start, so
-        wave sizing stays fixed WITHIN an unroll (the jitted step keeps
-        its bounded compiled-shape set) and retunes between unrolls."""
-        frac = 1.0 - self.AUTO_FRACTION_SLOPE * self._straggler_ewma
-        self.ready_fraction = min(1.0, max(self.AUTO_FRACTION_MIN, frac))
+    def _set_ready_fraction(self, value: float) -> None:
+        """The `pool_ready_fraction` knob's apply hook. Only
+        `ready_fraction` mutates — the driving actor re-reads it at each
+        unroll start, so wave sizing stays fixed WITHIN an unroll (the
+        jitted step keeps its bounded compiled-shape set) and retunes
+        between unrolls."""
+        self.ready_fraction = float(value)
         self._m_ready_fraction.set(self.ready_fraction)
+
+    def _update_auto_fraction(self) -> None:
+        """Tick the control-plane policy: the TargetMapPolicy maps the
+        straggler-rate EWMA onto the measured rate->fraction line and the
+        knob clamps to [AUTO_FRACTION_MIN, 1.0] and applies."""
+        knob = self._fraction_knob
+        proposal = self._fraction_policy.tick({}, time.monotonic(), knob)
+        if proposal is not None:
+            knob.propose(proposal.target)
 
     def _restart(self, w: int, reason: str) -> None:
         self._in_flight.discard(w)  # a fresh worker has nothing in flight

@@ -1768,8 +1768,21 @@ class Learner:
         shape-polymorphic over K, so the chunk size costs one retrace —
         not a new program per step. Each chunked dispatch increments
         perf/fused_fallbacks."""
-        chunk = self._fused_fallback_k
         K = self._config.steps_per_dispatch
+        if K <= 1:
+            # No [K, ...] superbatch axis to slice at K=1 — chunking
+            # would chop the time axis instead. Degrade to the one-shot
+            # step (a stray _fused_fallback_k must not corrupt shapes).
+            (
+                self._params,
+                self._opt_state,
+                self._popart_state,
+                logs,
+            ) = self._train_step(
+                self._params, self._opt_state, self._popart_state, *arrays
+            )
+            return logs
+        chunk = max(1, min(int(self._fused_fallback_k), K))
         logs = None
         for lo in range(0, K, chunk):
             part = jax.tree.map(

@@ -70,6 +70,7 @@ def train(
     on_learner_step: Optional[Callable[[int], None]] = None,
     trace_path: Optional[str] = None,
     perf_report_path: Optional[str] = None,
+    control=None,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -503,6 +504,33 @@ def train(
                 f"({supervisor.restarts} restarts performed); {detail}"
             )
 
+    # Closed-loop control plane (torched_impala_tpu/control/): tunes the
+    # hot-applicable runtime knobs from live telemetry on a background
+    # thread, every decision audited as control/* telemetry plus a
+    # control/decision flight-recorder event. Strictly optional: with
+    # `control` None or mode "off" nothing is built and the run is
+    # byte-identical to a pre-control-plane run.
+    control_loop = None
+    if control is not None and getattr(control, "mode", "off") == "auto":
+        from torched_impala_tpu.control import build_train_control
+
+        control_loop = build_train_control(
+            learner=learner,
+            traj_ring=traj_ring,
+            checkpointer=async_checkpointer,
+            batch_size=learner_config.batch_size,
+            steps_per_dispatch=getattr(
+                learner_config, "steps_per_dispatch", 1
+            ),
+            interval_s=control.interval_s,
+            tolerance=control.tolerance,
+            hysteresis=control.hysteresis,
+            cooldown_s=control.cooldown_s,
+            checkpoint_overhead_budget=control.checkpoint_overhead_budget,
+            allow_recompile=control.allow_recompile,
+        )
+        control_loop.start()
+
     stall_watchdog: Optional[StallWatchdog] = None
     if stall_timeout > 0:
 
@@ -521,6 +549,8 @@ def train(
     try:
         learner.run(remaining_steps, stop_event, watchdog=watchdog)
     finally:
+        if control_loop is not None:
+            control_loop.stop()
         if stall_watchdog is not None:
             stall_watchdog.stop()
         stop_event.set()

@@ -236,6 +236,11 @@ class PolicyServer:
         self._registry = registry
         self._max_clients = max_clients
         self._max_batch = min(max_batch, max_clients)
+        # Waves are always padded to `_pad_batch` so the jitted wave fn
+        # sees ONE shape for the server's lifetime; `_max_batch` is only
+        # the wave-formation cap and may be tuned down (never up past
+        # the pad) online by the control plane without a re-jit.
+        self._pad_batch = self._max_batch
         self._max_wait_s = float(max_wait_s)
         self._dtype = dtype
         self._example_obs = np.asarray(example_obs)
@@ -299,6 +304,32 @@ class PolicyServer:
     @property
     def max_batch(self) -> int:
         return self._max_batch
+
+    @property
+    def pad_batch(self) -> int:
+        """Fixed padded wave width (the jit shape). Never tunable."""
+        return self._pad_batch
+
+    @property
+    def max_wait_s(self) -> float:
+        return self._max_wait_s
+
+    def set_max_batch(self, n: int) -> None:
+        """Hot-apply path for the control plane: retune the
+        wave-formation cap within [1, pad_batch]. The pad width is
+        untouched, so this can never force a recompile."""
+        n = max(1, min(int(n), self._pad_batch))
+        with self._cond:
+            self._max_batch = n
+            self._cond.notify_all()
+
+    def set_max_wait_s(self, s: float) -> None:
+        """Hot-apply path for the control plane: retune the coalescing
+        window (clamped to >= 0)."""
+        s = max(0.0, float(s))
+        with self._cond:
+            self._max_wait_s = s
+            self._cond.notify_all()
 
     @property
     def registry(self) -> VersionRegistry:
@@ -575,7 +606,7 @@ class PolicyServer:
         return served
 
     def _run_label_wave(self, label: str, group: List[_Request]) -> int:  # lint: guarded-by(_service_lock)
-        B = self._max_batch
+        B = self._pad_batch
         n = len(group)
         # Resolve ONCE: every action in this wave comes from this exact
         # (version, params) snapshot, re-pins land on the next wave.
