@@ -300,6 +300,39 @@ class TestCLI:
         cfg = build_config(args)
         assert cfg.num_actions == 2
 
+    def test_feed_path_flags_reach_learner_config(self):
+        """`--superbatch-k` is the one-flag zero-copy bundle (ring +
+        donation + K-step dispatch); `--fused-epilogue`/`--train-dtype`
+        land on the loss config. Without them the learner config keeps
+        the exact pre-existing defaults."""
+        from torched_impala_tpu.configs import make_learner_config
+        from torched_impala_tpu.run import build_config, parse_args
+
+        cfg = build_config(
+            parse_args(
+                [
+                    "--config", "cartpole",
+                    "--superbatch-k", "4",
+                    "--fused-epilogue",
+                    "--train-dtype", "bfloat16",
+                ]
+            )
+        )
+        assert cfg.traj_ring and cfg.donate_batch
+        assert cfg.steps_per_dispatch == 4
+        lc = make_learner_config(cfg)
+        assert lc.traj_ring and lc.donate_batch
+        assert lc.steps_per_dispatch == 4
+        assert lc.loss.fused_epilogue
+        assert lc.loss.train_dtype == "bfloat16"
+
+        plain = make_learner_config(
+            build_config(parse_args(["--config", "cartpole"]))
+        )
+        assert not plain.donate_batch and not plain.loss.fused_epilogue
+        assert plain.loss.train_dtype == "float32"
+        assert plain.steps_per_dispatch == 1
+
     def test_replay_flags_reach_learner_config(self):
         """The five replay flags override the preset and materialize as
         a validated ReplayConfig on the LearnerConfig; without them the

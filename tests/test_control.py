@@ -533,6 +533,75 @@ class TestBuildTrainControl:
         assert (spec.lo, spec.hi, spec.step) == (0, 4, 2)
         assert spec.clamp(8) == 4.0
 
+    def test_steps_per_dispatch_ceiling_tracks_superbatch_max(self):
+        # ISSUE 13: the superbatch ring delivers up to SUPERBATCH_MAX_K
+        # per dispatch, so the gated K knob's ceiling derives from it —
+        # not from a multiple of the configured K (which pinned the old
+        # fused ceiling at 4*K=8 for the default K=2).
+        from torched_impala_tpu.control.loop import SUPERBATCH_MAX_K
+
+        reg = Registry()
+        loop = build_train_control(
+            steps_per_dispatch=2,
+            allow_recompile=True,
+            cooldown_s=0.0,
+            telemetry=reg,
+            tracer=FlightRecorder(capacity=256),
+        )
+        knob = loop.knobs["steps_per_dispatch"]
+        assert knob.spec.hi == float(SUPERBATCH_MAX_K) > 8.0
+
+        # With recompiles allowed, a hill climb on a monotone objective
+        # must actually reach past the old K=8 ceiling.
+        box = {"obj": 1.0}
+        loop.bind(
+            knob,
+            HillClimbPolicy(
+                FnSignal(lambda: box["obj"]),
+                tolerance=0.05,
+                hysteresis=0.01,
+                cooldown_s=0.0,
+            ),
+        )
+        now, peak = 0.0, 0.0
+        for _ in range(60):
+            loop.tick(now=now)
+            # Outwait the recompile gate's 300s amortization window and
+            # keep the objective visibly improving after every apply.
+            now += 301.0
+            box["obj"] *= 1.5
+            peak = max(peak, knob.value)
+        # The climb tops out at the new ceiling (then probes back down —
+        # a monotone objective judges every move a win).
+        assert peak == float(SUPERBATCH_MAX_K) > 8.0
+
+    def test_fused_chunk_hill_climbs_past_old_k8_ceiling(self):
+        # A SUPERBATCH_MAX_K learner's chunk knob spans (0, 16, 8): the
+        # built-in MFU hill climb reaches full-K chunking (> 8) when the
+        # signal rewards it.
+        from torched_impala_tpu.control.loop import SUPERBATCH_MAX_K
+
+        lr = _FakeLearner()
+        reg = Registry()
+        mfu = reg.gauge("perf/mfu")
+        loop = build_train_control(
+            learner=lr,
+            steps_per_dispatch=SUPERBATCH_MAX_K,
+            cooldown_s=0.0,
+            telemetry=reg,
+            tracer=FlightRecorder(capacity=256),
+        )
+        spec = loop.knobs["learner_fused_chunk"].spec
+        assert (spec.lo, spec.hi, spec.step) == (0, 16, 8)
+        now, obj, peak = 0.0, 0.1, 0
+        for _ in range(50):
+            mfu.set(obj)
+            loop.tick(now=now)
+            now += 60.0
+            obj *= 1.5  # every probe judged a clear win
+            peak = max(peak, lr._fused_fallback_k)
+        assert peak == SUPERBATCH_MAX_K > 8
+
     def test_collaborators_optional(self):
         loop = build_train_control(
             telemetry=Registry(), tracer=FlightRecorder(capacity=64)

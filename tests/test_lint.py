@@ -467,6 +467,37 @@ class TestDtypeChecker:
         """Half cast inside a jit root and f32 stats: silent."""
         assert dtypes.check([fixture("dtype_good.py")]) == []
 
+    def test_fused_compute_dtype_allowlist_is_surgical(self):
+        """The ONE sanctioned half binding in vtrace_pallas.py (the
+        fused epilogue's compute-dtype allow-list, ISSUE 13) is exempt
+        from the accumulator-module rule — but only that assignment;
+        any other half token in the same file still fires, and the same
+        binding name in a DIFFERENT vtrace module is not exempt."""
+        allowed_rel = "torched_impala_tpu/ops/vtrace_pallas.py"
+        body = (
+            "_FUSED_COMPUTE_DTYPES = (\n"
+            '    "float32",\n'
+            '    "bfloat16",\n'
+            ")\n"
+        )
+        sf = SourceFile(f"<{allowed_rel}>", allowed_rel, body)
+        assert dtypes.check([sf]) == []
+        # A second, unsanctioned half token in the allow-listed file.
+        sf2 = SourceFile(
+            f"<{allowed_rel}>",
+            allowed_rel,
+            body + 'rogue = "bfloat16"\n',
+        )
+        found = dtypes.check([sf2])
+        assert rules_of(found) == {"dtype/half-in-accumulator-module"}
+        assert [f.line for f in found] == [5]
+        # Same binding name in another vtrace-named module: not exempt.
+        other_rel = "torched_impala_tpu/ops/vtrace_other.py"
+        sf3 = SourceFile(f"<{other_rel}>", other_rel, body)
+        assert "dtype/half-in-accumulator-module" in rules_of(
+            dtypes.check([sf3])
+        )
+
 
 # ---- transitive hot-loop analysis (ISSUE 11 satellite) -------------------
 

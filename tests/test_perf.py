@@ -229,6 +229,47 @@ def test_analyze_pipelined_feeder_only_charges_gap_portion():
     assert learner["coverage_frac"] == pytest.approx(1.0)
 
 
+def test_categorize_donated_h2d_span():
+    # The donated-ring staging span is H2D time like device_put
+    # (ISSUE 13 zero-copy feed path).
+    assert categorize_span("learner/h2d") == "h2d"
+
+
+def test_analyze_overlapped_h2d_not_charged_and_frac_reported():
+    # Step N's H2D rides entirely inside step N-1's compute: it must
+    # charge NO gap anywhere, and the report's h2d_overlap_frac says
+    # 1.0 — the double-buffered staging win, measured.
+    records = [
+        _span(0, 10, "learner/train_step", {}),
+        _span(2, 6, "learner/h2d", {"batch": 1}),
+        _span(10, 10, "learner/train_step", {}),
+        _span(12, 4, "learner/h2d", {"batch": 2}),
+        _span(20, 10, "learner/train_step", {}),
+    ]
+    learner = analyze_records(records)["learner"]
+    assert learner["gap_total_s"] == 0.0
+    assert learner["gaps_s"]["h2d"] == 0.0
+    assert learner["h2d_total_s"] == pytest.approx(0.010)
+    assert learner["h2d_overlap_frac"] == pytest.approx(1.0)
+    assert learner["compute_frac"] == pytest.approx(1.0)
+
+
+def test_analyze_partially_overlapped_h2d_charges_only_gap_part():
+    # H2D [8, 14) spans the step boundary at 10: the overlapped [8, 10)
+    # is free, only the in-gap [10, 14) is charged as h2d, and the
+    # fraction reports the 2/6 that hid under compute.
+    records = [
+        _span(0, 10, "learner/train_step", {}),
+        _span(8, 6, "learner/h2d", {}),
+        _span(16, 10, "learner/train_step", {}),
+    ]
+    learner = analyze_records(records)["learner"]
+    assert learner["gaps_s"]["h2d"] == pytest.approx(0.004)
+    assert learner["gaps_s"]["unattributed"] == pytest.approx(0.002)
+    assert learner["h2d_overlap_frac"] == pytest.approx(2 / 6)
+    assert learner["coverage_frac"] == pytest.approx(1.0)
+
+
 def test_analyze_splits_fresh_from_replayed():
     # BatchLineage convention: reuse_count 1 == fresh first delivery;
     # only re-deliveries (> 1) count as replayed.

@@ -285,16 +285,19 @@ class TestRingPipeline:
             example_obs=np.zeros((4,), np.float32),
             rng=jax.random.key(0),
         )
-        with pytest.raises(ValueError, match="steps_per_dispatch"):
-            Learner(
-                config=LearnerConfig(
-                    batch_size=2,
-                    unroll_length=3,
-                    traj_ring=True,
-                    steps_per_dispatch=2,
-                ),
-                **common,
-            )
+        # Superbatch ring (ISSUE 13): traj_ring + steps_per_dispatch>1
+        # is now the fused feed path — the ring allocates [K, ...] slots.
+        sb = Learner(
+            config=LearnerConfig(
+                batch_size=2,
+                unroll_length=3,
+                traj_ring=True,
+                steps_per_dispatch=2,
+            ),
+            **common,
+        )
+        assert sb.traj_ring.superbatch_k == 2
+        assert sb.traj_ring._slots[0].buffers.obs.shape == (2, 4, 2, 4)
         with pytest.raises(ValueError, match="single-device"):
             Learner(
                 config=LearnerConfig(

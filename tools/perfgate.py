@@ -58,6 +58,21 @@ BUDGETS: Dict[str, Dict[str, Any]] = {
         "min": 1_000_000.0,
         "fingerprint_contains": "tpu",
     },
+    # ISSUE 13 zero-copy feed path. Backend-agnostic floors (empty
+    # fingerprint scope): both numbers are RATIOS of same-backend
+    # quantities, so the claim holds wherever the bench runs — the
+    # donated put must overwhelmingly overlap in-flight compute, and
+    # the fused V-trace+loss epilogue must beat the separate path by
+    # >= 10% (measured ~0.70x at the full bench shape on CPU; the
+    # analytic VJP that buys this is backend-independent).
+    "h2d_overlap_frac": {
+        "min": 0.8,
+        "fingerprint_contains": "",
+    },
+    "fused_epilogue_step_ratio": {
+        "max": 0.9,
+        "fingerprint_contains": "",
+    },
 }
 
 

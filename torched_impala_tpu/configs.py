@@ -102,7 +102,7 @@ class ExperimentConfig:
     # deliberately separate from compute_dtype (the torso lever):
     # bfloat16 measured +9-14% at d_model>=512 or T>=256 but -9% at the
     # small pong_transformer shapes (cast overhead dominates a d256/T20
-    # core; NOTES_r04.md), so it is opt-in, not inherited. Ignored (f32
+    # core; docs/notes/NOTES_r04.md), so it is opt-in, not inherited. Ignored (f32
     # forced, with a warning) on the sequence-parallel path.
     transformer_dtype: str = "float32"
     # Dense-attention kernel: "auto" picks pallas-vs-einsum from the
@@ -175,6 +175,15 @@ class ExperimentConfig:
     # [K, ...] superbatch) — amortizes per-dispatch host latency at the
     # cost of params publish landing every K steps (LearnerConfig docs).
     steps_per_dispatch: int = 1
+    # Zero-copy feed path (ISSUE 13, `--superbatch-k` bundles all
+    # three pieces): donate ring slots straight into the compiled train
+    # step (no host staging copy, slot released one step behind), run
+    # the loss epilogue fused with the V-trace recursion, and pick the
+    # [T, B, A] softmax/elementwise compute dtype (bf16 allowed; the
+    # recursion and all accumulators stay f32).
+    donate_batch: bool = False
+    fused_epilogue: bool = False
+    train_dtype: str = "float32"
     total_env_frames: int = 1_000_000
     # Optimization.
     lr: float = 6e-4
@@ -253,7 +262,7 @@ class ExperimentConfig:
 
 # Dense-attention 'auto' crossover: use the Pallas flash kernel only when
 # the learner's score matrix reaches this many elements. Measured on ONE
-# v5e through a tunnel (r4, NOTES_r04.md): the kernel pays decisively
+# v5e through a tunnel (r4, docs/notes/NOTES_r04.md): the kernel pays decisively
 # from T*S ~ 1M (1.25-1.46x at T=1024 f32, 2.5x at T=4096 bf16) but is
 # ~12% slower fwd+bwd than XLA's fused einsum at the pong_transformer
 # preset's T=21/S=149 (kernel-launch overhead over a 3k-element tile);
@@ -390,10 +399,13 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
             vf_coef=cfg.vf_coef,
             entropy_coef=cfg.entropy_coef,
             reduction=cfg.loss_reduction,
+            fused_epilogue=cfg.fused_epilogue,
+            train_dtype=cfg.train_dtype,
         ),
         max_grad_norm=cfg.max_grad_norm,
         steps_per_dispatch=cfg.steps_per_dispatch,
         traj_ring=cfg.traj_ring,
+        donate_batch=cfg.donate_batch,
         replay=replay,
         popart=(
             PopArtConfig(

@@ -49,6 +49,13 @@ from torched_impala_tpu.telemetry import get_recorder, get_registry
 
 DECISION_EVENT = "control/decision"
 
+# Largest fused-dispatch K the superbatch trajectory ring is sized for
+# (runtime/traj_ring.py [K, T+1, B, ...] slots; ISSUE 13). Knob ceilings
+# below derive from this so the controller can explore past the old K=8
+# fused ceiling without outrunning what the feed path can actually
+# deliver.
+SUPERBATCH_MAX_K = 16
+
 
 @dataclasses.dataclass
 class _Binding:
@@ -361,7 +368,12 @@ def build_train_control(
                 KnobSpec(
                     "steps_per_dispatch",
                     lo=1,
-                    hi=max(2.0, 4.0 * steps_per_dispatch),
+                    # Ceiling tracks the superbatch ring's sizing, not a
+                    # multiple of the configured K: the feed path can
+                    # deliver up to SUPERBATCH_MAX_K per dispatch.
+                    hi=float(
+                        max(SUPERBATCH_MAX_K, 2 * steps_per_dispatch)
+                    ),
                     step=1,
                     kind="int",
                     recompile=True,

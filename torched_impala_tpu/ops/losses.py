@@ -43,6 +43,18 @@ class ImpalaLossConfig:
     # NON-LEVER either way: both sit at the dispatch floor (~0.2% of a
     # train step) on a real v5e — see ops/vtrace.py:vtrace.
     vtrace_implementation: str = "auto"
+    # Fused V-trace + loss epilogue (ops/vtrace_pallas.fused_vtrace_loss):
+    # ONE log_softmax serves ratios + policy gradient + entropy, the
+    # recursion and the three masked reductions run next to each other
+    # (inside the Pallas kernel on TPU), and the backward pass is an
+    # analytic elementwise VJP. False = the exact pre-existing separate
+    # epilogue, op for op.
+    fused_epilogue: bool = False
+    # Compute dtype of the fused epilogue's [T, B, A] softmax /
+    # elementwise phase ('float32' or 'bfloat16'). Only consulted when
+    # fused_epilogue is on; recursion, reductions, and PopArt stats stay
+    # f32 regardless (the accumulator contract tools/lint polices).
+    train_dtype: str = "float32"
 
 
 class LossOutput(NamedTuple):
@@ -176,6 +188,20 @@ def impala_loss(
       LossOutput(total, logs) where logs holds the per-component scalars the
       learner publishes (SURVEY.md §6 metrics set).
     """
+    if config.fused_epilogue:
+        from torched_impala_tpu.ops.vtrace_pallas import fused_vtrace_loss
+
+        return fused_vtrace_loss(
+            target_logits=target_logits,
+            behaviour_logits=behaviour_logits,
+            values=values,
+            bootstrap_value=bootstrap_value,
+            actions=actions,
+            rewards=rewards,
+            discounts=discounts,
+            mask=mask,
+            config=config,
+        )
     if mask is None:
         mask = jnp.ones_like(rewards)
     mask = mask.astype(values.dtype)

@@ -227,7 +227,7 @@ def vtrace(
     devices (correct for un-meshed callers only).
 
     Performance: a NON-LEVER at trained shapes. The r4 steady-state 6x3
-    (T, B) grid (NOTES_r04.md "V-trace kernel-vs-scan closure") found
+    (T, B) grid (docs/notes/NOTES_r04.md "V-trace kernel-vs-scan closure") found
     BOTH implementations at the dispatch-latency floor (~17-42 us/call,
     ~0.2% of a train step); the earlier round-2 multi-x speedup readings
     were dispatch noise around a sub-ulp op. 'auto' -> pallas on TPU is kept

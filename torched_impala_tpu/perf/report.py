@@ -60,7 +60,11 @@ def categorize_span(name: str) -> Optional[str]:
     component, _, sub = name.partition("/")
     if name == "learner/publish":
         return "publish"
-    if name == "learner/device_put":
+    if name in ("learner/device_put", "learner/h2d"):
+        # learner/h2d is the donated-ring staging span (zero-copy feed
+        # path); learner/device_put the copying one. Same category: both
+        # are host->device transfer time, and the union-and-subtract
+        # below charges only the part NOT overlapped by a train_step.
         return "h2d"
     if "compile" in sub:
         return "compile"
@@ -204,6 +208,15 @@ def analyze_records(
         gaps[cat] = got
     gaps["unattributed"] = measure(uncovered)
 
+    # How much of the H2D transfer time hid under compute: the double-
+    # buffered staging win. Overlapped H2D is charged to NOTHING (it is
+    # not a gap), so this fraction is the report's proof that the feed
+    # path actually pipelines — 1.0 means every transfer rode a step.
+    h2d_total_ns = measure(by_category["h2d"])
+    h2d_overlapped_ns, _ = subtract(
+        list(by_category["h2d"]), gap_intervals
+    )
+
     def _s(ns: int) -> float:
         return ns / 1e9
 
@@ -217,6 +230,10 @@ def analyze_records(
         "gap_frac": {
             k: (v / wall_ns if wall_ns else 0.0) for k, v in gaps.items()
         },
+        "h2d_total_s": _s(h2d_total_ns),
+        "h2d_overlap_frac": (
+            h2d_overlapped_ns / h2d_total_ns if h2d_total_ns else 0.0
+        ),
         # compute + every attributed category + unattributed remainder:
         # the acceptance coverage (tiles the wall-clock by construction,
         # modulo clock skew between threads).
@@ -280,6 +297,12 @@ def render_report(report: Dict[str, Any]) -> str:
             f"  coverage {learner['coverage_frac']:.1%} "
             f"(attributed {learner['attributed_frac']:.1%})"
         )
+        if learner.get("h2d_total_s"):
+            lines.append(
+                f"  h2d: {learner['h2d_total_s']:.3f}s total, "
+                f"{learner['h2d_overlap_frac']:.1%} overlapped with "
+                "compute"
+            )
         rep = learner.get("replayed") or {}
         if rep.get("steps"):
             lines.append(

@@ -95,6 +95,21 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="fuse K SGD steps into one dispatched XLA program "
                         "(amortizes host dispatch latency; params publish "
                         "every K steps — see LearnerConfig)")
+    p.add_argument("--superbatch-k", type=int, default=None, metavar="K",
+                   help="zero-copy feed path bundle: trajectory ring with "
+                        "[K, ...] superbatch slots donated straight into "
+                        "the fused K-step dispatch (sets --traj-ring, "
+                        "--steps-per-dispatch K, and buffer donation)")
+    p.add_argument("--fused-epilogue", action="store_true",
+                   help="run the V-trace recursion and the pg/value/"
+                        "entropy loss epilogue in one fused pass with an "
+                        "analytic VJP (ops/vtrace_pallas.py)")
+    p.add_argument("--train-dtype", choices=("float32", "bfloat16"),
+                   default=None,
+                   help="compute dtype for the fused epilogue's [T, B, A] "
+                        "softmax/elementwise phase; recursion and "
+                        "accumulators stay f32 (bfloat16 needs "
+                        "--fused-epilogue)")
     p.add_argument("--grad-accum", type=int, default=None,
                    help="accumulate gradients over G microbatches before "
                         "one optimizer update (same numbers as the full "
@@ -300,6 +315,7 @@ def build_config(args: argparse.Namespace):
         ("transformer_attention", "transformer_attention"),
         ("transformer_dtype", "transformer_dtype"),
         ("env_id", "env_id"),
+        ("train_dtype", "train_dtype"),
         ("trace", "trace_path"),
         ("perf_report", "perf_report"),
     ):
@@ -310,6 +326,14 @@ def build_config(args: argparse.Namespace):
         overrides["remat_torso"] = True
     if args.traj_ring:
         overrides["traj_ring"] = True
+    if args.fused_epilogue:
+        overrides["fused_epilogue"] = True
+    if args.superbatch_k:
+        # The one-flag zero-copy bundle: superbatch ring slots donated
+        # into the fused K-step dispatch.
+        overrides["traj_ring"] = True
+        overrides["steps_per_dispatch"] = args.superbatch_k
+        overrides["donate_batch"] = True
     control_overrides = {}
     if args.control is not None:
         control_overrides["mode"] = args.control

@@ -88,7 +88,7 @@ class LearnerConfig:
     # Fuse K SGD steps into ONE dispatched XLA program (`lax.scan` over a
     # [K, ...] superbatch). Each host→device dispatch carries fixed latency
     # (RPC + argument handling — ~24% of step wall time on a tunnelled
-    # chip, NOTES_r02.md trace analysis); fusing K steps amortizes it K-fold.
+    # chip, docs/notes/NOTES_r02.md trace analysis); fusing K steps amortizes it K-fold.
     # Costs: params publish / telemetry land every K steps instead of every
     # step (actor staleness grows by up to K-1 extra updates — V-trace is
     # built for exactly this), and K batches are resident on device at once.
@@ -148,7 +148,20 @@ class LearnerConfig:
     # ALIAS host numpy (the stack_buffer_reuse probe), each batch is
     # staged through one owning copy instead — still one copy fewer
     # than the queue path's actor-buffer + stack chain.
+    # With steps_per_dispatch=K > 1 the ring allocates SUPERBATCH slots
+    # ([K, T+1, B, ...] — traj_ring.superbatch_k): actors fill K*B
+    # columns, a completed slot IS the fused dispatch's xs, and the
+    # chunked-K fallback becomes the exception rather than the rule.
     traj_ring: bool = False
+    # Donate the batch arrays into the train step (zero-copy feed path):
+    # XLA may reuse the batch buffers as scratch, eliminating the
+    # defensive staging copy between ring slot and train_step. In ring
+    # mode slots are released only after the consuming step completes
+    # (instead of after the H2D transfer), so donation is safe even on
+    # backends where device_put aliases host memory. Off (default)
+    # keeps the exact pre-existing path. Incompatible with replay (a
+    # retained slot's contents must survive for re-delivery).
+    donate_batch: bool = False
     # Backend NAME ("cpu") the batcher device_puts assembled batches to,
     # instead of the default device. A measurement/staging knob (bench's
     # feeder section uses it to time the ingest path against the local
@@ -180,13 +193,16 @@ class BatchLineage(NamedTuple):
     adds `reuse_count` (which delivery of the slot's contents this batch
     is; 1 = fresh) and `staleness` (frame delta to the learner watermark
     at delivery) so the train-step trace span distinguishes replayed
-    from fresh consumption."""
+    from fresh consumption. `ring_slot` >= 0 marks a DONATED ring batch:
+    the slot's buffers back the device arrays, so step_once releases the
+    slot only after the consuming step completes (-1 = not donated)."""
 
     batch: int
     lineage: tuple = ()
     versions: tuple = ()
     reuse_count: int = 1
     staleness: int = 0
+    ring_slot: int = -1
 
 
 def _put_format(x, fmt):
@@ -546,6 +562,30 @@ class Learner:
         # after a jit-boundary layout refusal (perf observatory; the
         # companion perf/mfu gauges register lazily in _observe_perf).
         self._m_fused_fallbacks = reg.counter("perf/fused_fallbacks")
+        # Zero-copy feed path (donate_batch): how much of the H2D
+        # dispatch wall-time landed inside a train step's compute window
+        # (the overlapped-H2D design point). ns counters so bench can
+        # snapshot window deltas; the gauge is the cumulative fraction.
+        # `learner/donated_batches` counts batches fed without a staging
+        # copy (the donation gauge OBSERVABILITY.md documents).
+        self._m_h2d_total_ns = reg.counter("perf/h2d_ns_total")
+        self._m_h2d_overlap_ns = reg.counter("perf/h2d_ns_overlapped")
+        self._m_h2d_overlap_frac = reg.gauge("perf/h2d_overlap_frac")
+        self._m_donated_batches = reg.counter("learner/donated_batches")
+        self._h2d_total_ns = 0
+        self._h2d_overlap_ns = 0
+        # Recent train-step compute intervals + the in-flight step's
+        # start, read by the batcher thread to score each H2D dispatch
+        # against compute. Benign cross-thread race: stale reads only
+        # under-count overlap.
+        self._step_intervals: collections.deque = collections.deque(
+            maxlen=64
+        )
+        self._step_active_since_ns: Optional[int] = None  # lint: guarded-by(gil)
+        # Donated ring slots awaiting their consuming step's completion:
+        # (slot, probe) pairs, released by _finish_step one step behind
+        # so the release never stalls the pipeline.
+        self._donated_slots: collections.deque = collections.deque()
         reg.gauge("queue/capacity").set(capacity)
         # Live depth, read lazily at snapshot time. Weakref: the global
         # registry must not keep a dead learner's queue (and its queued
@@ -587,6 +627,13 @@ class Learner:
                     "has no microbatch scan)"
                 )
 
+        if config.popart is not None and config.loss.fused_epilogue:
+            raise ValueError(
+                "fused_epilogue does not compose with PopArt yet (the "
+                "per-task rescaling epilogue keeps the separate loss "
+                "path; PopArt stats stay f32 either way)"
+            )
+
         # Zero-copy trajectory ring (LearnerConfig.traj_ring): slots are
         # complete [T+1, B, ...] batches actors write in place. Sized so
         # the device queue can hold its depth in transferred slots while
@@ -604,23 +651,32 @@ class Learner:
                     "traj_ring cannot combine with data_device (the "
                     "measurement knob keeps the queue path)"
                 )
-            if config.steps_per_dispatch != 1:
+            if config.steps_per_dispatch > 1 and self._replay is not None:
                 raise ValueError(
-                    "traj_ring requires steps_per_dispatch=1 (the "
-                    "[K, ...] superbatch keeps the queue path)"
+                    "traj_ring superbatch (steps_per_dispatch > 1) does "
+                    "not compose with replay: a retained slot cannot be "
+                    "re-delivered column-by-column across K sub-batches"
                 )
             replaying = (
                 self._replay is not None and self._replay.max_reuse > 1
             )
+            # donate_batch holds each slot one step PAST its transfer
+            # (released after the consuming step), which would leave the
+            # free list empty at steady state and serialize writers on
+            # the release — two extra slots restore the slack so ready
+            # slots are waiting whenever the device queue has room and
+            # the H2D dispatch lands inside the next step's compute.
             self.traj_ring = TrajectoryRing(
                 num_slots=config.device_queue_depth
                 + 2
-                + (2 if replaying else 0),
+                + (2 if replaying else 0)
+                + (2 if config.donate_batch else 0),
                 unroll_length=config.unroll_length,
                 batch_size=self._local_batch_size,
                 example_obs=np.asarray(example_obs),
                 num_actions=agent.net.num_actions,
                 agent_state_example=agent.initial_state(1),
+                superbatch_k=config.steps_per_dispatch,
                 telemetry=reg,
                 tracer=self._tracer,
                 max_reuse=self._replay.max_reuse if replaying else 1,
@@ -673,6 +729,23 @@ class Learner:
                 )
         fused = config.steps_per_dispatch > 1
         step_impl = self._train_multi_impl if fused else self._train_step_impl
+        if config.donate_batch:
+            if mesh is not None:
+                raise ValueError(
+                    "donate_batch supports the single-device learner "
+                    "only (the mesh path keeps non-donated batches)"
+                )
+            if config.data_device is not None:
+                raise ValueError(
+                    "donate_batch cannot combine with data_device (the "
+                    "measurement knob keeps the copy path)"
+                )
+            if self._replay is not None:
+                raise ValueError(
+                    "donate_batch does not compose with replay: a "
+                    "retained slot's contents must survive the step for "
+                    "re-delivery, donation lets XLA scribble on them"
+                )
         # AUTO-layout machinery (config.auto_layouts): compiled lazily by
         # the batcher from the first assembled batch's avals, so cheap
         # Learner constructions (tests, doctor) pay nothing.
@@ -694,7 +767,29 @@ class Learner:
         # program would then refuse.
         self._replay_step = None
         if mesh is None:
-            self._train_step = jax.jit(step_impl, donate_argnums=(0, 1, 2))
+            # donate_batch extends donation past the state triple to the
+            # eight batch arguments (argnums 3..10): XLA may reuse the
+            # batch buffers as scratch, so the feed path never stages a
+            # defensive copy between ring slot and step (the zero-copy
+            # contract; the ring slot recycles only after the consuming
+            # step completes).
+            donate = (
+                tuple(range(11))
+                if config.donate_batch
+                else (0, 1, 2)
+            )
+            if config.donate_batch:
+                # Batch buffers rarely match an output shape, so XLA
+                # reports them "not usable" for output reuse on some
+                # backends — expected here (donation still frees XLA to
+                # scratch over them); don't warn once per compile.
+                import warnings
+
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable",
+                )
+            self._train_step = jax.jit(step_impl, donate_argnums=donate)
             if self._replay is not None:
                 self._replay_step = jax.jit(
                     self._train_step_replay_impl, donate_argnums=(0, 1, 2)
@@ -708,7 +803,7 @@ class Learner:
                 if auto is not None:  # jax without AUTO layouts: plain jit
                     self._auto_jit = jax.jit(
                         step_impl,
-                        donate_argnums=(0, 1, 2),
+                        donate_argnums=donate,
                         in_shardings=auto,
                         out_shardings=auto,
                     )
@@ -1213,7 +1308,16 @@ class Learner:
             # block must NEVER be skipped — strong references, not
             # weakrefs (a dead weakref can't prove the copy ran; an early
             # version skipped the block on dead refs and raced).
-            jax.block_until_ready(pending)
+            # donate_batch exception: a DELETED leaf proves the
+            # consuming step already ran, which implies the transfer
+            # completed — and block_until_ready on it would raise.
+            pending = [
+                leaf
+                for leaf in pending
+                if not getattr(leaf, "is_deleted", lambda: False)()
+            ]
+            if pending:
+                jax.block_until_ready(pending)
             self._ring_pending[i] = None
         if self._ring[i] is None:
             self._ring[i] = alloc_stack_buffers(trajs, K)
@@ -1262,7 +1366,12 @@ class Learner:
         self._ring_pending[slot] = leaves
 
     def _next_batch_lineage(
-        self, lineage, versions, reuse_count: int = 1, staleness: int = 0
+        self,
+        lineage,
+        versions,
+        reuse_count: int = 1,
+        staleness: int = 0,
+        ring_slot: int = -1,
     ) -> BatchLineage:
         """Stamp the next batch id on the consumed unrolls' provenance
         (batcher thread only — the sequence needs no lock)."""
@@ -1274,6 +1383,7 @@ class Learner:
             versions=tuple(int(v) for v in versions),
             reuse_count=int(reuse_count),
             staleness=int(staleness),
+            ring_slot=int(ring_slot),
         )
         self._last_lineage = meta
         return meta
@@ -1399,6 +1509,34 @@ class Learner:
         # local slice becomes its shards of the global batch array.
         return multihost.place_batch(self._batch_shardings, arrays)
 
+    def _note_h2d(self, t0_ns: int, t1_ns: int) -> int:
+        """Score one H2D dispatch interval against the learner's recent
+        train-step compute intervals (batcher thread; the overlap half
+        of the zero-copy feed path). Returns the overlapped ns and
+        updates the perf/h2d_* counters plus the cumulative
+        perf/h2d_overlap_frac gauge."""
+        total = max(0, t1_ns - t0_ns)
+        ov = 0
+        for s0, s1 in tuple(self._step_intervals):
+            ov += max(0, min(t1_ns, s1) - max(t0_ns, s0))
+        active = self._step_active_since_ns
+        if active is not None:
+            # The in-flight step has no end yet; everything past its
+            # start overlaps compute. The min() cap below absorbs the
+            # benign race where it finishes mid-call and lands in
+            # _step_intervals too.
+            ov += max(0, t1_ns - max(t0_ns, active))
+        ov = min(ov, total)
+        self._h2d_total_ns += total
+        self._h2d_overlap_ns += ov
+        self._m_h2d_total_ns.inc(total)
+        self._m_h2d_overlap_ns.inc(ov)
+        if self._h2d_total_ns:
+            self._m_h2d_overlap_frac.set(
+                self._h2d_overlap_ns / self._h2d_total_ns
+            )
+        return ov
+
     def _push_device_batch(
         self,
         on_device,
@@ -1454,10 +1592,12 @@ class Learner:
             put_span.__enter__()
             on_device = self._put_batch(arrays)
             put_span.__exit__()
+            put_dur = time.monotonic_ns() - put_t0
+            self._note_h2d(put_t0, put_t0 + put_dur)
             self._tracer.complete(
                 "learner/device_put",
                 put_t0,
-                time.monotonic_ns() - put_t0,
+                put_dur,
                 {"batch": meta.batch},
             )
             self._record_pending_transfer(on_device)
@@ -1478,12 +1618,22 @@ class Learner:
         the queued batch, so each batch stages through ONE owning copy
         instead and the slot recycles immediately — still one copy fewer
         than the queue path's actor-buffer + np.stack chain; the copy is
-        accounted under learner/ring_stage_bytes, not host_stack."""
+        accounted under learner/ring_stage_bytes, not host_stack.
+
+        donate_batch short-circuits BOTH fallbacks (zero-copy contract):
+        no staging copy and no transfer-bounded recycling, because the
+        slot is released only after the consuming step completes
+        (step_once, via meta.ring_slot) — at that point XLA is done
+        reading (and possibly scribbling on) the slot's memory, and the
+        next acquire/commit cycle rewrites every column anyway."""
         ring = self.traj_ring
         keep = self._config.device_queue_depth
         inflight: collections.deque = collections.deque()
-        copy_before_put = not self._stack_reuse_enabled()
-        alias_checked = False
+        donate = self._config.donate_batch
+        copy_before_put = (
+            not self._stack_reuse_enabled() and not donate
+        )
+        alias_checked = donate
         while not self._stop.is_set():
             view = ring.pop_ready(timeout=0.5)
             if view is None:
@@ -1493,6 +1643,7 @@ class Learner:
                 view.versions,
                 reuse_count=view.reuse_count,
                 staleness=view.staleness,
+                ring_slot=view.slot if donate else -1,
             )
             stack_t0 = time.monotonic_ns()
             with self._m_host_stack.time():
@@ -1519,13 +1670,28 @@ class Learner:
             put_span.__enter__()
             on_device = self._put_batch(arrays)
             put_span.__exit__()
-            self._tracer.complete(
-                "learner/device_put",
-                put_t0,
-                time.monotonic_ns() - put_t0,
-                {"batch": meta.batch},
-            )
-            if copy_before_put:
+            put_dur = time.monotonic_ns() - put_t0
+            overlap_ns = self._note_h2d(put_t0, put_t0 + put_dur)
+            if donate:
+                # Distinct span name for the overlapped path: report.py
+                # scores learner/h2d* against compute intervals and must
+                # not double-charge the overlapped part as gap.
+                self._tracer.complete(
+                    "learner/h2d",
+                    put_t0,
+                    put_dur,
+                    {"batch": meta.batch, "overlap_ns": overlap_ns},
+                )
+            else:
+                self._tracer.complete(
+                    "learner/device_put",
+                    put_t0,
+                    put_dur,
+                    {"batch": meta.batch},
+                )
+            if donate:
+                self._m_donated_batches.inc()
+            elif copy_before_put:
                 # The staged copy owns its memory; the slot is free now.
                 ring.release(view.slot)
             else:
@@ -1633,6 +1799,9 @@ class Learner:
             self._m_batch_wait.observe(wait)
         step_t0 = time.monotonic()
         step_t0_ns = time.monotonic_ns()
+        # Mark the step in flight for the batcher's H2D-overlap scoring
+        # (_note_h2d); _finish_step records the closed interval.
+        self._step_active_since_ns = step_t0_ns
         if self._replay_step is not None:
             # IMPACT path: the pinned target params ride as a fourth
             # (non-donated) state arg. current() raises past the
@@ -1739,10 +1908,11 @@ class Learner:
                 _alive(self._params)
                 and _alive(self._opt_state)
                 and _alive(self._popart_state)
+                and _alive(arrays)
             ):
                 raise RuntimeError(
                     "layout fallback: the failed step consumed its "
-                    "donated state buffers; restart from the last "
+                    "donated buffers; restart from the last "
                     "checkpoint (this path is only reachable if the "
                     "backend validates layouts after donation)"
                 ) from e
@@ -1837,6 +2007,8 @@ class Learner:
         # next host iteration; the steady-state EWMA still tracks the
         # device step (the pipeline re-synchronizes on the batch queue).
         step_dur_ns = time.monotonic_ns() - step_t0_ns
+        self._step_intervals.append((step_t0_ns, step_t0_ns + step_dur_ns))
+        self._step_active_since_ns = None
         self._m_train_step.observe(time.monotonic() - step_t0)
         self._observe_perf(step_dur_ns)
         T = self._config.unroll_length
@@ -1857,6 +2029,20 @@ class Learner:
         # the param_lag_frames gauge summarizes by its min-version).
         if meta is None:
             meta = BatchLineage(batch=-1)
+        if meta.ring_slot >= 0:
+            # Donated ring batch: recycle the slot only once its
+            # consuming step completed. Release runs ONE step behind —
+            # block on the previous step's log leaf, which finished
+            # before this step started executing (device steps are
+            # serialized by the params chain) — so recycling never
+            # stalls the just-dispatched step.
+            self._donated_slots.append(
+                (meta.ring_slot, jax.tree.leaves(logs)[:1])
+            )
+            while len(self._donated_slots) > 1:
+                slot, probe = self._donated_slots.popleft()
+                jax.block_until_ready(probe)  # lint: allow(jit-boundary/host-sync-in-hot-loop)
+                self.traj_ring.release(slot)
         lags = [self.num_frames - v for v in meta.versions]
         self._tracer.complete(
             "learner/train_step",

@@ -45,6 +45,18 @@ retained list, so eviction can never recycle buffers mid-consumption
 With ``max_reuse == 1`` every code path below is byte-for-byte today's
 behavior and no ``replay/*`` series are registered — the bit-parity
 contract tests/test_replay.py pins.
+
+Superbatch mode (``superbatch_k > 1`` — the zero-copy feed path):
+every slot allocates a leading ``[K]`` axis (``obs`` is
+``[K, T+1, B, ...]``) and holds K*B columns; writers still acquire
+plain ``[T+1, E, ...]`` column views (a block never straddles a
+``B`` boundary, so each block lands in exactly one of the K
+sub-batches) and a slot completes when all K*B columns commit. The
+delivered ``ReadySlot.arrays`` then carry the ``[K, ...]`` leading
+axis the learner's fused multi-step dispatch consumes directly — one
+H2D transfer and one dispatch for K SGD steps, no host re-stacking.
+With ``superbatch_k == 1`` buffer shapes and delivery are exactly
+today's (no leading axis) — the disabled-flag parity contract.
 """
 
 from __future__ import annotations
@@ -149,6 +161,7 @@ class TrajectoryRing:
         replay_mix: float = 1.0,
         staleness_frames: int = 0,
         sampler_seed: int = 0,
+        superbatch_k: int = 1,
     ) -> None:
         if num_slots < 2:
             # One slot can never overlap filling with an in-flight H2D
@@ -156,6 +169,15 @@ class TrajectoryRing:
             raise ValueError(f"need >= 2 slots, got {num_slots}")
         if unroll_length < 1 or batch_size < 1:
             raise ValueError("unroll_length and batch_size must be >= 1")
+        if superbatch_k < 1:
+            raise ValueError(
+                f"superbatch_k must be >= 1, got {superbatch_k}"
+            )
+        if superbatch_k > 1 and max_reuse > 1:
+            raise ValueError(
+                "superbatch slots cannot be replayed (max_reuse > 1): "
+                "the surrogate path consumes [T, B] batches"
+            )
         if max_reuse < 1:
             raise ValueError(f"max_reuse must be >= 1, got {max_reuse}")
         if not (0.0 < replay_mix <= 1.0):
@@ -166,40 +188,46 @@ class TrajectoryRing:
             )
         obs = np.asarray(example_obs)
         T, B = unroll_length, batch_size
+        K = int(superbatch_k)
         self.unroll_length = T
         self.batch_size = B
+        self.superbatch_k = K
+        self.total_cols = K * B
         self.num_slots = num_slots
         self.obs_shape = obs.shape
         self.obs_dtype = obs.dtype
         self.num_actions = int(num_actions)
         # Per-env agent-state template (leaves [1, ...], the shape each
         # Trajectory carries); slot leaves concatenate to [B, ...] —
-        # mirroring learner.alloc_stack_buffers exactly.
+        # mirroring learner.alloc_stack_buffers exactly. Superbatch
+        # slots carry a leading [K] axis on every leaf (K == 1 keeps
+        # the exact non-superbatch shapes — no leading axis).
         state_template = jax.tree.map(np.asarray, agent_state_example)
+        lead = () if K == 1 else (K,)
 
         def slot_buffers() -> Trajectory:
             def state(x):
                 return np.empty(
-                    (B * x.shape[0],) + x.shape[1:], x.dtype
+                    lead + (B * x.shape[0],) + x.shape[1:], x.dtype
                 )
 
             return Trajectory(
-                obs=np.empty((T + 1, B) + obs.shape, obs.dtype),
-                first=np.empty((T + 1, B), np.bool_),
-                actions=np.empty((T, B), np.int32),
+                obs=np.empty(lead + (T + 1, B) + obs.shape, obs.dtype),
+                first=np.empty(lead + (T + 1, B), np.bool_),
+                actions=np.empty(lead + (T, B), np.int32),
                 behaviour_logits=np.empty(
-                    (T, B, self.num_actions), np.float32
+                    lead + (T, B, self.num_actions), np.float32
                 ),
-                rewards=np.empty((T, B), np.float32),
-                cont=np.empty((T, B), np.float32),
+                rewards=np.empty(lead + (T, B), np.float32),
+                cont=np.empty(lead + (T, B), np.float32),
                 agent_state=jax.tree.map(state, state_template),
                 actor_id=-1,
                 param_version=0,
-                task=np.empty((B,), np.int32),
+                task=np.empty(lead + (B,), np.int32),
             )
 
         self._slots: List[_Slot] = [
-            _Slot(slot_buffers(), B) for _ in range(num_slots)
+            _Slot(slot_buffers(), self.total_cols) for _ in range(num_slots)
         ]
         self._free: collections.deque = collections.deque(range(num_slots))
         self._ready: collections.deque = collections.deque()
@@ -254,7 +282,8 @@ class TrajectoryRing:
         a full trajectory queue). Raises QueueClosed after `close()`.
 
         `num_cols` must divide `batch_size` so blocks never straddle a
-        slot boundary (every writer's columns land in ONE batch).
+        slot boundary (every writer's columns land in ONE batch — and,
+        in superbatch mode, in ONE of the slot's K sub-batches).
         `lineage_id` tags the flight-recorder acquire span (the span's
         duration IS the ring backpressure the writer just paid)."""
         if num_cols < 1 or self.batch_size % num_cols:
@@ -278,7 +307,7 @@ class TrajectoryRing:
                     slot = self._slots[s]
                     c0 = slot.next_col
                     slot.next_col += num_cols
-                    if slot.next_col >= self.batch_size:
+                    if slot.next_col >= self.total_cols:
                         self._filling = None  # fully handed out
                     now = time.monotonic()
                     self._m_acquire_ms.observe((now - t0) * 1e3)
@@ -294,6 +323,31 @@ class TrajectoryRing:
     def _block(self, s: int, cols: slice) -> RingBlock:
         slot = self._slots[s]
         buf = slot.buffers
+        if self.superbatch_k > 1:
+            # Global column range -> (sub-batch k, local columns). A
+            # block never straddles a B boundary (num_cols divides B),
+            # so the writer's view is a plain [T+1, E, ...] slice of
+            # ONE sub-batch — identical in shape to the K == 1 view.
+            k = cols.start // self.batch_size
+            local = slice(
+                cols.start - k * self.batch_size,
+                cols.stop - k * self.batch_size,
+            )
+            return RingBlock(
+                slot=s,
+                cols=cols,
+                gen=slot.gen,
+                obs=buf.obs[k][:, local],
+                first=buf.first[k][:, local],
+                actions=buf.actions[k][:, local],
+                behaviour_logits=buf.behaviour_logits[k][:, local],
+                rewards=buf.rewards[k][:, local],
+                cont=buf.cont[k][:, local],
+                task=buf.task[k][local],
+                agent_state=jax.tree.map(
+                    lambda x: x[k][local], buf.agent_state
+                ),
+            )
         return RingBlock(
             slot=s,
             cols=cols,
@@ -356,7 +410,7 @@ class TrajectoryRing:
 
     def _maybe_complete_locked(self, s: int) -> None:
         slot = self._slots[s]
-        if slot.committed < self.batch_size:
+        if slot.committed < self.total_cols:
             return
         if slot.aborted:
             self._m_aborted.inc()
@@ -556,8 +610,18 @@ class TrajectoryRing:
         `jax.block_until_ready` returns, jax's (possibly background-
         dispatched) H2D copy may still read the slot's host buffers, so
         the block must never be skipped (same contract as the learner's
-        stack-buffer ring). The wait lands in `ring/recycle_wait_ms`."""
+        stack-buffer ring). The wait lands in `ring/recycle_wait_ms`.
+
+        Donated feed path: a batch donated into the train step may
+        already be consumed (deleted) by the time the batcher recycles
+        its slot — a deleted buffer proves the H2D completed (the step
+        that consumed it ran), so it is simply skipped."""
         t0 = time.monotonic()
+        pending = [
+            x
+            for x in jax.tree.leaves(pending)
+            if not (hasattr(x, "is_deleted") and x.is_deleted())
+        ]
         if pending:
             jax.block_until_ready(pending)
         self._m_recycle_ms.observe((time.monotonic() - t0) * 1e3)
@@ -590,20 +654,21 @@ class TrajectoryRing:
         obs = np.asarray(example_obs)
         buf = self._slots[0].buffers
         T, B = self.unroll_length, self.batch_size
+        lead = () if self.superbatch_k == 1 else (self.superbatch_k,)
         problems: List[str] = []
-        if buf.obs.shape != (T + 1, B) + obs.shape:
+        if buf.obs.shape != lead + (T + 1, B) + obs.shape:
             problems.append(
                 f"obs slot shape {buf.obs.shape} != expected "
-                f"{(T + 1, B) + obs.shape}"
+                f"{lead + (T + 1, B) + obs.shape}"
             )
         if buf.obs.dtype != obs.dtype:
             problems.append(
                 f"obs slot dtype {buf.obs.dtype} != env {obs.dtype}"
             )
-        if buf.behaviour_logits.shape != (T, B, num_actions):
+        if buf.behaviour_logits.shape != lead + (T, B, num_actions):
             problems.append(
                 f"logits slot shape {buf.behaviour_logits.shape} != "
-                f"expected {(T, B, num_actions)}"
+                f"expected {lead + (T, B, num_actions)}"
             )
         for name, arr, dtype in (
             ("first", buf.first, np.bool_),
