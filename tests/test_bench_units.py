@@ -338,3 +338,81 @@ def test_tracing_bench_overhead_bound(jax_cpu):
     # The export really saw the ring's retained records.
     assert raw["export_events"] > 0, raw
     assert out["overhead_pct"] < 10.0, out
+
+
+def test_loadgen_bench_fleet_beats_single_and_fails_over(jax_cpu):
+    """The ISSUE 14 acceptance bounds, wired into CI via the bench
+    loadgen section's tiny variant. Both arms serve int8 behind the
+    parity gate under the same open-loop Poisson stream with draining
+    rollouts every 150 ms, and the chaos harness kills one server
+    mid-wave at the midpoint arrival: the 2-replica fleet must absorb
+    the incident (failed == 0, goodput >= 1.5x the single arm — the
+    deterministic mechanism gives ~2x, the single arm loses the second
+    half of the window) while keeping p99 inside the SLO budget; the
+    standalone failover scenario must mark exactly one replica dead
+    and answer its in-flight requests via the one retry."""
+    from bench import run_bench_loadgen
+
+    out = run_bench_loadgen(jax_cpu, tiny=True)
+    assert out["dtype"] == "int8" and out["int8_parity"], out
+    # Incident-window ratio: the kill really bit the single arm...
+    assert out["single"]["failed"] > 0, out
+    # ...and the fleet arm absorbed the same fault without one error.
+    assert out["fleet"]["failed"] == 0, out
+    assert out["fleet"]["retried"] >= 1, out
+    assert out["fleet_goodput_ratio"] >= 1.5, out
+    assert out["serving_p99_ms"] <= out["slo_ms"], out
+    # Rollouts kept landing under live load on the fleet arm, zero
+    # dropped/errored requests (the fleet `failed == 0` above covers
+    # the drops; this covers the rollouts actually happening).
+    assert out["rollouts_fleet"] >= 3, out
+    assert out["rollout_error_fleet"] is None, out
+    # Standalone failover scenario: chaos fault fired, one replica
+    # dead, the router's exactly-once retry answered the orphans.
+    assert out["failover_faults_fired"] == 1, out
+    assert len(out["failover_dead"]) == 1, out
+    assert out["failover"]["failed"] == 0, out
+    assert out["failover"]["retried"] >= 1, out
+    # Disconnect chaos riders were exercised (by design, not failures).
+    assert out["failover"]["disconnected"] > 0, out
+
+
+def test_loadgen_budgets_pinned_in_perfgate():
+    """The fleet serving floors are load-bearing: the full bench's
+    loadgen records must be gated by perfgate's pinned budgets on every
+    backend (empty fingerprint scope) — the goodput ratio is a same-box
+    quotient, and serving_p99_ms is gated against the 50 ms SLO budget
+    itself."""
+    from tools.perfgate import BUDGETS, check_records
+
+    assert BUDGETS["fleet_goodput_ratio"] == {
+        "min": 1.5,
+        "fingerprint_contains": "",
+    }
+    assert BUDGETS["serving_p99_ms"] == {
+        "max": 50.0,
+        "fingerprint_contains": "",
+    }
+
+    def rec(metric, value, direction):
+        return {
+            "metric": metric,
+            "value": value,
+            "direction": direction,
+            "fingerprint": "somebox|x86_64|cpu1",
+            "sha": "deadbeef",
+        }
+
+    good = [
+        rec("fleet_goodput_ratio", 1.99, "higher"),
+        rec("serving_p99_ms", 2.6, "lower"),
+    ]
+    assert check_records(good) == []
+    bad = [
+        rec("fleet_goodput_ratio", 1.1, "higher"),
+        rec("serving_p99_ms", 95.0, "lower"),
+    ]
+    findings = check_records(bad)
+    assert len(findings) == 2, findings
+    assert any("fleet_goodput_ratio" in f for f in findings)
+    assert any("serving_p99_ms" in f for f in findings)

@@ -251,6 +251,14 @@ class TestMetricsChecker:
         # 3f likewise: control/decisions_made fires even though it
         # contains "decision"
         assert "control/decisions_made" in msgs
+        # 3g: the fleet_/route_ serving sub-families are prefix
+        # matches too — fleetsize/routesplit fire despite containing
+        # "fleet"/"route"
+        assert "serving/fleetsize" in msgs
+        assert "serving/routesplit" in msgs
+        # 4b closed set: serving/rollout is pinned, serving/rollback
+        # is not
+        assert "serving/rollback" in msgs
         # prose string and malformed-charset literal must NOT flag
         assert "bad key here" not in msgs and "bad/Key" not in msgs
 

@@ -17,9 +17,10 @@ Rules (rule ids in parentheses):
 3. literal emitted keys (``"telemetry/..."`` strings,
    ``f"{PREFIX}/..."`` interpolations) carry the same grammar
    (``telemetry/literal-key``);
-3b/3c/3d/3e/3f. ``resilience/*``, ``serving/*``, ``replay/*``,
-   ``perf/*`` and ``control/*`` names use their pinned sub-family
-   prefixes (``telemetry/subfamily-prefix``);
+3b/3c/3d/3e/3f/3g. ``resilience/*``, ``serving/*`` (3g extends the set
+   with the fleet_/route_ sub-families), ``replay/*``, ``perf/*`` and
+   ``control/*`` names use their pinned sub-family prefixes
+   (``telemetry/subfamily-prefix``);
 4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
    ``.complete`` — follow the same slug grammar
    (``telemetry/trace-grammar``);
@@ -70,8 +71,11 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
 _CANONICAL = {"span": "timer"}
 
 RESILIENCE_PREFIXES = ("checkpoint_", "supervisor_", "chaos_", "recovery_")
+# Rule 3g (serving fleet, ISSUE 14) adds the fleet topology/rollout and
+# router-decision sub-families to the serving/* set pinned since ISSUE 6.
 SERVING_PREFIXES = (
     "request_", "wave_", "shadow_", "client_", "version_", "ring_",
+    "fleet_", "route_",
 )
 # Rule 3d (replay subsystem, ISSUE 9): the replay/* family is pinned to
 # the four sub-families docs/OBSERVABILITY.md documents — reuse
@@ -92,6 +96,8 @@ PERF_PREFIXES = ("mfu_", "membw_", "flops_", "gap_", "fused_", "h2d_")
 CONTROL_PREFIXES = ("decision_", "revert_", "objective_", "knob_")
 SERVING_TRACE_EVENTS = {
     "serving/request", "serving/wave", "serving/shadow",
+    # ISSUE 14 fleet instants: rollout lifecycle + replica failover.
+    "serving/rollout", "serving/failover",
 }
 
 # These files define the machinery; their docstring examples would read

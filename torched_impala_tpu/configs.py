@@ -223,11 +223,15 @@ class ExperimentConfig:
     # `serving_max_batch` is the padded wave width (ONE compiled shape);
     # `serving_wait_ms` the coalescing window (a wave launches when
     # max_batch distinct clients wait OR the oldest request ages this
-    # much); `serving_dtype` opts serving into bf16-cast params — gated
-    # on the f32 greedy-action parity check (serving.greedy_action_parity).
+    # much); `serving_dtype` opts serving into bf16-cast or int8
+    # per-channel-quantized params — both gated on the f32 greedy-action
+    # parity check (serving.greedy_action_parity);
+    # `serving_replicas` > 1 serves through a ServingFleet (replicated
+    # PolicyServers + least-loaded router, serving/fleet.py).
     serving_max_batch: int = 32
     serving_wait_ms: float = 2.0
     serving_dtype: str = "float32"
+    serving_replicas: int = 1
     # Flight-recorder export (telemetry/tracing.py): write the retained
     # trace events — per-unroll lineage IDs threaded env→pool→queue/
     # ring→learner with exact per-batch param lag — as Chrome-trace

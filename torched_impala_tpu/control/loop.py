@@ -388,7 +388,8 @@ def build_train_control(
 
 def build_serving_control(
     *,
-    server,
+    server=None,
+    fleet=None,
     slo_ms: float = 25.0,
     interval_s: float = 1.0,
     cooldown_s: float = 2.0,
@@ -402,17 +403,56 @@ def build_serving_control(
     better batching efficiency. ``max_batch`` here is the wave-formation
     cap only — padding stays at the fixed ``pad_batch``, so no value the
     controller picks can trigger a re-jit.
-    """
+
+    Pass `server` for the single-replica shape (knob names unchanged:
+    `serving_max_wait_ms` / `serving_max_batch`), or `fleet` to bind the
+    same pair PER REPLICA (`serving_max_wait_ms_r0`, ...). Per-replica
+    binding is deliberate: replicas drain/die independently, so one
+    shared knob would keep retuning a replica that is not taking
+    traffic. All replicas track the shared request-wait p99 signal (the
+    wave path aggregates across replicas into one registry)."""
+    if (server is None) == (fleet is None):
+        raise ValueError(
+            "build_serving_control needs exactly one of server= / fleet="
+        )
     loop = ControlLoop(
         interval_s=interval_s, telemetry=telemetry, tracer=tracer
     )
+    targets = (
+        [(server, "")]
+        if fleet is None
+        else [(rep.server, f"_{rep.name}") for rep in fleet.replicas()]
+    )
+    for srv, suffix in targets:
+        _bind_serving_knobs(
+            loop,
+            srv,
+            suffix,
+            slo_ms=slo_ms,
+            interval_s=interval_s,
+            cooldown_s=cooldown_s,
+            telemetry=telemetry,
+        )
+    return loop
+
+
+def _bind_serving_knobs(
+    loop: ControlLoop,
+    server,
+    suffix: str,
+    *,
+    slo_ms: float,
+    interval_s: float,
+    cooldown_s: float,
+    telemetry,
+) -> None:
     pad = server.pad_batch
     wait0 = server.max_wait_s
 
     loop.bind(
         Knob(
             KnobSpec(
-                "serving_max_wait_ms",
+                f"serving_max_wait_ms{suffix}",
                 lo=0.0,
                 hi=max(1e-3, wait0) * 1e3,
                 step=max(1e-3, wait0) * 1e3 / 4.0,
@@ -431,7 +471,7 @@ def build_serving_control(
         loop.bind(
             Knob(
                 KnobSpec(
-                    "serving_max_batch",
+                    f"serving_max_batch{suffix}",
                     lo=1,
                     hi=pad,
                     step=max(1, pad // 4),
@@ -449,4 +489,3 @@ def build_serving_control(
                 cooldown_s=cooldown_s,
             ),
         )
-    return loop

@@ -923,6 +923,135 @@ def _check_serving(seed: int = 0) -> tuple[str, str]:
         return "FAIL", f"serving tier broken:\n{traceback.format_exc()}"
 
 
+def _check_fleet(seed: int = 0) -> tuple[str, str]:
+    """Fleet-tier self-check (docs/SERVING.md "Fleet"): a 2-replica
+    in-process ServingFleet serves through the least-loaded router under
+    live multi-client traffic while one draining rollout re-pins both
+    replicas to a new version — zero dropped/errored requests, and every
+    (replica, wave) group serves exactly one version. Then the int8
+    parity gate (serving/quant.py) must pass on clean quantization and
+    CATCH a seeded scale corruption."""
+    import threading
+
+    import numpy as np
+
+    try:
+        import jax
+
+        from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+        from torched_impala_tpu.runtime.param_store import ParamStore
+        from torched_impala_tpu.serving import (
+            FleetClient,
+            ServingFleet,
+            corrupt_scales,
+            dequantize_params,
+            greedy_action_parity,
+            quantize_params,
+        )
+
+        agent = Agent(
+            ImpalaNet(num_actions=4, torso=MLPTorso(hidden_sizes=(32,)))
+        )
+        example = np.zeros((8,), np.float32)
+        params = agent.init_params(jax.random.key(seed), example)
+        store = ParamStore()
+        store.publish(0, params)
+        store.publish(1, params)
+        fleet = ServingFleet(
+            agent=agent,
+            store=store,
+            example_obs=example,
+            replicas=2,
+            version=0,
+            max_clients=8,
+            max_batch=4,
+            max_wait_s=0.0,
+            seed=seed,
+        ).start()
+        results: list = []
+        errors: list = []
+        lock = threading.Lock()
+        rng = np.random.default_rng(seed)
+        obs = rng.normal(size=(4, 8)).astype(np.float32)
+
+        def drive(wid: int) -> None:
+            client = FleetClient(fleet, client_id=wid)
+            try:
+                for _ in range(25):
+                    res = client.act_full(obs[wid], True)
+                    with lock:
+                        results.append(res)
+            except Exception as e:  # noqa: BLE001 — the check's verdict
+                with lock:
+                    errors.append(e)
+            finally:
+                client.close()
+
+        try:
+            threads = [
+                threading.Thread(target=drive, args=(w,), daemon=True)
+                for w in range(4)
+            ]
+            for t in threads:
+                t.start()
+            rollout = fleet.rollout(1, timeout_s=20.0)
+            for t in threads:
+                t.join(timeout=30.0)
+            with FleetClient(fleet) as probe:
+                final = probe.act_full(obs[0], True)
+        finally:
+            fleet.close()
+        if errors:
+            return "FAIL", (
+                f"rollout under traffic dropped requests: {errors[:3]}"
+            )
+        if len(results) != 100:
+            return "FAIL", f"expected 100 served requests, got {len(results)}"
+        if rollout["replicas"] != ["r0", "r1"]:
+            return "FAIL", f"rollout skipped replicas: {rollout}"
+        if final.version != 1:
+            return "FAIL", f"post-rollout serves v{final.version}, not v1"
+        by_wave: dict = {}
+        for res in results:
+            by_wave.setdefault((res.replica, res.wave), set()).add(
+                res.version
+            )
+        mixed = {k: v for k, v in by_wave.items() if len(v) > 1}
+        if mixed:
+            return "FAIL", f"mixed versions within a wave: {mixed}"
+        replicas_used = {res.replica for res in results}
+        if replicas_used != {"r0", "r1"}:
+            return "FAIL", (
+                f"router used {replicas_used}, expected both replicas"
+            )
+        parity_ok, mm = greedy_action_parity(
+            agent, params, obs, dtype="int8"
+        )
+        if not parity_ok:
+            return "FAIL", f"int8 parity gate: {mm} mismatches vs f32"
+        corrupted_ok, corrupted_mm = greedy_action_parity(
+            agent,
+            params,
+            obs,
+            cast_fn=lambda p: dequantize_params(
+                corrupt_scales(quantize_params(p))
+            ),
+        )
+        if corrupted_ok:
+            return "FAIL", (
+                "int8 parity gate MISSED a seeded scale corruption"
+            )
+        return "ok", (
+            f"2-replica fleet served {len(results)} requests through "
+            "the router with a mid-traffic draining rollout v0->v1 "
+            "(zero drops, per-wave version uniformity); int8 parity "
+            f"gate passes clean and catches corrupted scales "
+            f"({corrupted_mm} mismatches)"
+        )
+    except Exception:
+        return "FAIL", f"serving fleet broken:\n{traceback.format_exc()}"
+
+
 def _train_probe(config_name: str) -> tuple[str, str]:
     """Two real learner steps through the full runtime on the preset's
     REAL envs (no fakes) — the end-to-end first-contact check."""
@@ -1030,6 +1159,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_serving()
     print(f"  serving    [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_fleet()
+    print(f"  fleet      [{status}] {detail}")
     failed |= status == "FAIL"
     status, detail = _check_lint()
     print(f"  lint       [{status}] {detail}")

@@ -28,6 +28,19 @@ Fault kinds and the hook site each rides:
   corrupt_checkpoint  save      overwrite bytes inside the just-written
                                 checkpoint file; the recovery scan must
                                 reject it and fall back one step
+  kill_server_mid_wave serving  abrupt PolicyServer death at the top of
+                                a wave (pending requests fail
+                                ServerClosed); the fleet router must
+                                mark the replica DEAD and retry each
+                                in-flight request elsewhere exactly once
+  corrupt_pinned_version serving swap the params a replica's label is
+                                pinned to for a shape-truncated tree in
+                                the store ring; the next wave raises,
+                                the server fails the group cleanly and
+                                kills itself, the fleet fails over
+  wedge_shm_ring      pump      stall the shm request-ring pump for
+                                `duration_s` — a wedged cross-process
+                                transport under live clients
 
 Sites count monotonically from 1; a fault fires when its site's counter
 reaches `at` (once — every fault is one-shot). The injector is
@@ -55,6 +68,9 @@ KINDS = (
     "wedge_queue",
     "crash_learner",
     "corrupt_checkpoint",
+    "kill_server_mid_wave",
+    "corrupt_pinned_version",
+    "wedge_shm_ring",
 )
 
 _SITE_OF = {
@@ -64,6 +80,9 @@ _SITE_OF = {
     "wedge_queue": "enqueue",
     "crash_learner": "learner",
     "corrupt_checkpoint": "save",
+    "kill_server_mid_wave": "serving",
+    "corrupt_pinned_version": "serving",
+    "wedge_shm_ring": "pump",
 }
 
 
@@ -248,11 +267,37 @@ class ChaosInjector:
             if f.kind == "corrupt_checkpoint":
                 corrupt_file(path)
 
+    def serving_hook(self, server, replica: int = -1) -> None:
+        """Attach as `PolicyServer.chaos_hook` (install binds one per
+        fleet replica with its index as the target); called at the top
+        of every wave execution, before any label group runs.
+
+        kill_server_mid_wave: abrupt `server.kill()` — the wave's
+        requests fail ServerClosed without an answer, exactly a replica
+        process dying between dequeue and compute. corrupt_pinned_version:
+        bit-rot the pinned snapshot in the store ring (below) so the
+        wave itself raises and the server's fail-the-group path runs."""
+        for f in self._trigger("serving", target=replica):
+            if f.kind == "kill_server_mid_wave":
+                server.kill(reason="chaos kill_server_mid_wave")
+            elif f.kind == "corrupt_pinned_version":
+                corrupt_pinned_params(server.registry)
+
+    def pump_hook(self, pump=None) -> None:
+        """Attach as `ShmRingPump.chaos_hook`; wedge_shm_ring stalls one
+        pump scan for duration_s — clients see latency, never errors."""
+        for f in self._trigger("pump"):
+            if f.kind == "wedge_shm_ring":
+                time.sleep(f.duration_s)
+
     def install(
         self,
         *,
         pools: Sequence = (),
         checkpointer=None,
+        fleets: Sequence = (),
+        servers: Sequence = (),
+        pumps: Sequence = (),
     ) -> None:
         """Convenience wiring for the hookable objects that take
         attributes (actors/enqueue/post-step hooks are wired where those
@@ -261,6 +306,44 @@ class ChaosInjector:
             pool.chaos_hook = self.pool_hook
         if checkpointer is not None:
             checkpointer._post_save = self.checkpoint_hook
+        for fleet in fleets:
+            for i, rep in enumerate(fleet.replicas()):
+                rep.server.chaos_hook = (
+                    lambda srv, _i=i: self.serving_hook(srv, replica=_i)
+                )
+        for server in servers:
+            server.chaos_hook = self.serving_hook
+        for pump in pumps:
+            pump.chaos_hook = self.pump_hook
+
+
+def corrupt_pinned_params(registry) -> int:
+    """Bit-rot the snapshot a registry's first pinned label resolves to:
+    swap the params in the store's retention ring for a copy whose first
+    multi-row leaf is TRUNCATED along axis 0 (reaching into `_ring` the
+    way pool_hook reaches into `_procs` — chaos simulates damage the
+    public API exists to prevent). The next wave that resolves the label
+    fails at trace time with a shape error; the server must fail that
+    group with ServerClosed and kill itself rather than wedge clients.
+    Returns the corrupted version."""
+    import jax
+
+    pinned = registry.pinned()
+    label = sorted(pinned)[0]
+    version = pinned[label]
+    store = registry.store
+    params = store.get_version(version)
+    leaves, treedef = jax.tree.flatten(params)
+    for i, leaf in enumerate(leaves):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] >= 2:
+            leaves[i] = leaf[:-1]
+            break
+    corrupted = jax.tree.unflatten(treedef, leaves)
+    with store._lock:
+        store._ring[version] = corrupted
+        if store._version == version:
+            store._params = corrupted
+    return version
 
 
 def corrupt_file(path: str, offset_frac: float = 0.5, nbytes: int = 64) -> None:

@@ -211,12 +211,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "waves, versioned params, serving/* telemetry — "
                         "greedy eval returns are identical to the direct "
                         "path (docs/SERVING.md)")
-    p.add_argument("--serve-dtype", choices=("float32", "bfloat16"),
+    p.add_argument("--serve-dtype",
+                   choices=("float32", "bfloat16", "int8"),
                    default=None,
                    help="serving-path param dtype (default: preset's "
-                        "serving_dtype). bfloat16 is refused unless the "
-                        "f32 greedy-action parity gate passes on this "
-                        "checkpoint (docs/SERVING.md bf16 policy)")
+                        "serving_dtype). bfloat16 and int8 (per-channel "
+                        "weight quantization, serving/quant.py) are "
+                        "refused unless the f32 greedy-action parity "
+                        "gate passes on this checkpoint (docs/SERVING.md "
+                        "reduced-precision policy)")
+    p.add_argument("--serve-replicas", type=int, default=None, metavar="N",
+                   help="serve eval through an N-replica ServingFleet "
+                        "(least-loaded router + draining rollouts, "
+                        "serving/fleet.py) instead of one PolicyServer "
+                        "(default: preset's serving_replicas)")
     p.add_argument("--eval-stochastic", action="store_true",
                    help="sample actions instead of argmax")
     p.add_argument("--eval-max-steps", type=int, default=108_000,
@@ -885,14 +893,16 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
             )
         from torched_impala_tpu.runtime.param_store import ParamStore
         from torched_impala_tpu.serving import (
+            FleetClient,
             InProcessClient,
             PolicyServer,
+            ServingFleet,
             VersionRegistry,
             greedy_action_parity,
         )
 
         serve_dtype = args.serve_dtype or cfg.serving_dtype
-        if serve_dtype == "bfloat16":
+        if serve_dtype in ("bfloat16", "int8"):
             rng = np.random.default_rng(args.seed)
             example = configs.example_obs(cfg)
             if example.dtype == np.uint8:
@@ -903,44 +913,80 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
                 probe = rng.normal(size=(8, *example.shape)).astype(
                     example.dtype
                 )
-            ok, mismatches = greedy_action_parity(agent, params, probe)
+            ok, mismatches = greedy_action_parity(
+                agent, params, probe, dtype=serve_dtype
+            )
             if not ok:
                 print(
-                    f"error: bf16 serving refused — greedy-action parity "
-                    f"gate failed ({mismatches}/8 probe actions differ "
-                    "from f32); serve in float32 or retrain "
-                    "(docs/SERVING.md bf16 policy)",
+                    f"error: {serve_dtype} serving refused — "
+                    f"greedy-action parity gate failed ({mismatches}/8 "
+                    "probe actions differ from f32); serve in float32 "
+                    "or retrain (docs/SERVING.md reduced-precision "
+                    "policy)",
                     file=sys.stderr,
                 )
                 return 5
+        serve_replicas = (
+            args.serve_replicas
+            if args.serve_replicas is not None
+            else cfg.serving_replicas
+        )
+        if serve_replicas < 1:
+            raise SystemExit(
+                f"--serve-replicas must be >= 1, got {serve_replicas}"
+            )
         store = ParamStore()
         store.publish(0, params)
-        registry = VersionRegistry.serving_latest(store)
-        server = PolicyServer(
-            agent=agent,
-            registry=registry,
-            example_obs=configs.example_obs(cfg),
-            max_clients=4,
-            max_batch=min(4, cfg.serving_max_batch),
-            max_wait_s=cfg.serving_wait_ms / 1e3,
-            dtype=serve_dtype,
-            seed=args.seed,
-        ).start()
+        fleet = None
+        if serve_replicas > 1:
+            fleet = ServingFleet(
+                agent=agent,
+                store=store,
+                example_obs=configs.example_obs(cfg),
+                replicas=serve_replicas,
+                max_clients=4,
+                max_batch=min(4, cfg.serving_max_batch),
+                max_wait_s=cfg.serving_wait_ms / 1e3,
+                dtype=serve_dtype,
+                seed=args.seed,
+            ).start()
+            server = None
+        else:
+            registry = VersionRegistry.serving_latest(store)
+            server = PolicyServer(
+                agent=agent,
+                registry=registry,
+                example_obs=configs.example_obs(cfg),
+                max_clients=4,
+                max_batch=min(4, cfg.serving_max_batch),
+                max_wait_s=cfg.serving_wait_ms / 1e3,
+                dtype=serve_dtype,
+                seed=args.seed,
+            ).start()
         control_loop = None
         if cfg.control.mode == "auto":
             from torched_impala_tpu.control import build_serving_control
 
+            control_target = (
+                {"fleet": fleet} if fleet is not None else {"server": server}
+            )
             control_loop = build_serving_control(
-                server=server,
                 slo_ms=cfg.control.serving_slo_ms,
                 interval_s=min(1.0, cfg.control.interval_s),
+                **control_target,
             )
             control_loop.start()
         env = env_factory(args.seed + 777_000)
         try:
-            with InProcessClient(
-                server, greedy=not args.eval_stochastic
-            ) as client:
+            if fleet is not None:
+                client_cm = FleetClient(
+                    fleet, greedy=not args.eval_stochastic
+                )
+            else:
+                client_cm = InProcessClient(
+                    server, greedy=not args.eval_stochastic
+                )
+            with client_cm as client:
                 result = run_episodes(
                     env=env,
                     num_episodes=args.eval_episodes,
@@ -952,7 +998,10 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
         finally:
             if control_loop is not None:
                 control_loop.stop()
-            server.close()
+            if fleet is not None:
+                fleet.close()
+            if server is not None:
+                server.close()
             close = getattr(env, "close", None)
             if close is not None:
                 close()
@@ -960,7 +1009,8 @@ def run_eval(args, cfg, agent, checkpointer) -> int:
             f"eval: episodes={len(result.returns)} "
             f"mean_return={result.mean_return:.2f} "
             f"mean_length={result.mean_length:.1f} "
-            f"(serving path, dtype={serve_dtype})"
+            f"(serving path, dtype={serve_dtype}, "
+            f"replicas={serve_replicas})"
         )
         return 0
     if args.eval_parallel > 1:

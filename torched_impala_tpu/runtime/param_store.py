@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 
 class ParamStore:
@@ -50,6 +50,24 @@ class ParamStore:
         self._ring: "collections.OrderedDict[int, Any]" = (
             collections.OrderedDict()
         )
+        self._listeners: List[Callable[[int], None]] = []
+
+    def add_publish_listener(
+        self, fn: Callable[[int], None]
+    ) -> Callable[[int], None]:
+        """Register `fn(version)` to run after every publish (outside
+        the store lock, on the publisher's thread). The serving fleet
+        uses this to track rollout candidates without polling. Listener
+        exceptions are swallowed — a broken observer must never stall
+        the learner's publish path."""
+        with self._lock:
+            self._listeners.append(fn)
+        return fn
+
+    def remove_publish_listener(self, fn: Callable[[int], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def publish(self, version: int, params: Any) -> None:
         with self._lock:
@@ -59,7 +77,13 @@ class ParamStore:
             self._ring.move_to_end(version)
             while len(self._ring) > self._keep:
                 self._ring.popitem(last=False)
+            listeners = list(self._listeners)
         self._published.set()
+        for fn in listeners:
+            try:
+                fn(version)
+            except Exception:
+                pass
 
     def get(self, timeout: Optional[float] = None) -> tuple[int, Any]:
         """Latest (version, params); blocks until the first publish.

@@ -9,6 +9,23 @@ and the shm request ring (`serving/shm_ring.py`, cross-process).
 """
 
 from torched_impala_tpu.serving.client import InProcessClient  # noqa: F401
+from torched_impala_tpu.serving.fleet import (  # noqa: F401
+    FleetClient,
+    FleetResult,
+    Replica,
+    ServingFleet,
+)
+from torched_impala_tpu.serving.loadgen import (  # noqa: F401
+    LoadReport,
+    TrafficShape,
+    run_load,
+)
+from torched_impala_tpu.serving.quant import (  # noqa: F401
+    Int8Params,
+    corrupt_scales,
+    dequantize_params,
+    quantize_params,
+)
 from torched_impala_tpu.serving.registry import (  # noqa: F401
     VersionRegistry,
 )
@@ -33,17 +50,28 @@ from torched_impala_tpu.serving.shm_ring import (  # noqa: F401
 __all__ = [
     "ClientDisconnected",
     "DeadlineExpired",
+    "FleetClient",
+    "FleetResult",
     "InProcessClient",
+    "Int8Params",
+    "LoadReport",
     "PolicyServer",
+    "Replica",
     "RingBackpressure",
     "ServeResult",
     "ServerClosed",
     "ServingError",
+    "ServingFleet",
     "ShmRingClient",
     "ShmRingPump",
     "ShmServingRing",
+    "TrafficShape",
     "VersionRegistry",
     "cast_params",
+    "corrupt_scales",
+    "dequantize_params",
     "greedy_action_parity",
     "mint_request_lid",
+    "quantize_params",
+    "run_load",
 ]

@@ -45,6 +45,12 @@ class InProcessClient:
     def slot(self) -> int:
         return self._slot
 
+    @property
+    def server(self) -> PolicyServer:
+        """The replica behind this connection (the fleet router reads
+        it to invalidate cached slots when a replica dies)."""
+        return self._server
+
     def act_async(
         self,
         obs: np.ndarray,

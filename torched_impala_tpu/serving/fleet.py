@@ -1,0 +1,568 @@
+"""ServingFleet: replicated PolicyServers behind a least-loaded router.
+
+The million-user shape of the serving tier (docs/SERVING.md "Fleet"):
+N `PolicyServer` replicas share ONE learner-facing `ParamStore`, each
+behind its own `VersionRegistry` pinning the fleet label to the same
+version. Clients never talk to a replica directly — `FleetClient`
+routes every request through the fleet's client-side router:
+
+- WEIGHTED LEAST-LOADED ROUTING: `acquire()` picks the ACTIVE replica
+  minimizing `(inflight + 1) / weight` (ties prefer the heavier, then
+  lexicographically-first replica — fully deterministic, pinned by
+  tests/test_fleet.py). In-flight counts are reserved AT pick time, so
+  concurrent clients water-fill the fleet instead of stampeding one
+  replica; per-replica EWMA latency is tracked for observability and
+  the control plane.
+- HEALTH: replicas are ACTIVE, DRAINING (rollout in progress — no new
+  picks) or DEAD (failed over — never picked again). `acquire()` BLOCKS
+  while no replica is ACTIVE rather than failing, which is what makes
+  rollouts zero-drop even on a 1-replica fleet.
+- FAILOVER: a request that surfaces `ServerClosed` marks its replica
+  DEAD and retries on another replica EXACTLY ONCE (with `first=True` —
+  the dead replica took the recurrent carry with it). One retry bounds
+  worst-case latency amplification under correlated failures; the
+  second failure propagates.
+- DRAINING ROLLOUTS: `rollout(version)` walks the replicas one at a
+  time — mark DRAINING, wait for in-flight + queued to quiesce, re-pin
+  the label via the replica's own `VersionRegistry` (so per-wave
+  version uniformity is inherited from wave-consistency, not re-proved
+  here), return it to rotation. Zero dropped requests by construction:
+  a draining replica finishes what it owns and new work routes around
+  it.
+
+Telemetry: `serving/fleet_*` (topology + rollouts) and
+`serving/route_*` (router decisions) — pinned sub-families, lint rule
+3g. Trace instants `serving/rollout` and `serving/failover` join the
+closed serving trace set (rule 4b).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.runtime.param_store import ParamStore
+from torched_impala_tpu.serving.client import InProcessClient
+from torched_impala_tpu.serving.registry import VersionRegistry
+from torched_impala_tpu.serving.server import (
+    ClientDisconnected,
+    DeadlineExpired,
+    PolicyServer,
+    ServerClosed,
+    ServingError,
+)
+from torched_impala_tpu.telemetry.registry import Registry, get_registry
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+)
+
+ACTIVE = "active"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class FleetResult(NamedTuple):
+    """One routed request: the served action, its exact provenance, and
+    the routing decision that produced it."""
+
+    action: int
+    version: int
+    label: str
+    wave: int
+    replica: str  # replica name that answered
+    retried: bool  # True when the answer came from the one failover retry
+
+
+class Replica:
+    """One fleet member: a PolicyServer + its registry + router state.
+
+    Router fields (`state`, `inflight`, `ewma_ms`) are owned by the
+    fleet and only ever touched under the fleet's condition variable.
+    """
+
+    __slots__ = (
+        "name", "server", "registry", "weight", "state", "inflight",
+        "ewma_ms",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        server: PolicyServer,
+        registry: VersionRegistry,
+        weight: float,
+    ) -> None:
+        self.name = name
+        self.server = server
+        self.registry = registry
+        self.weight = float(weight)
+        self.state = ACTIVE
+        self.inflight = 0
+        self.ewma_ms: Optional[float] = None
+
+
+class ServingFleet:
+    """N PolicyServer replicas over one ParamStore + the router state.
+
+    Lifecycle: construct (replicas are built and the fleet label pinned
+    to one common version), `start()` the replica serve threads,
+    `FleetClient(fleet)` per logical client, `rollout()` to deploy,
+    `close()`. Construction does NOT start threads, so tests can drive
+    `service_once()` per replica deterministically.
+    """
+
+    def __init__(
+        self,
+        *,
+        agent: Agent,
+        store: ParamStore,
+        example_obs: np.ndarray,
+        replicas: int = 2,
+        weights: Optional[Sequence[float]] = None,
+        label: str = "live",
+        version: Optional[int] = None,
+        max_clients: int = 64,
+        max_batch: int = 32,
+        max_wait_s: float = 2e-3,
+        dtype: str = "float32",
+        seed: int = 0,
+        ewma_alpha: float = 0.2,
+        timeout: Optional[float] = None,
+        telemetry: Optional[Registry] = None,
+        tracer: Optional[FlightRecorder] = None,
+    ) -> None:
+        if replicas < 1:
+            raise ValueError(f"need replicas >= 1, got {replicas}")
+        if weights is None:
+            weights = [1.0] * replicas
+        if len(weights) != replicas or any(w <= 0 for w in weights):
+            raise ValueError(
+                f"weights must be {replicas} positive floats, got "
+                f"{weights!r}"
+            )
+        self._store = store
+        self._label = label
+        self._alpha = float(ewma_alpha)
+        self._cond = threading.Condition()
+        self._closed = False
+        self._latest_published = store.version
+        if version is None:
+            version = store.get(timeout=timeout)[0]
+        reg = telemetry if telemetry is not None else get_registry()
+        self._tracer = tracer if tracer is not None else get_recorder()
+        self._replicas: List[Replica] = []
+        for i in range(replicas):
+            registry = VersionRegistry(store, telemetry=reg)
+            registry.pin(label, version)
+            registry.set_routing({label: 1.0})
+            server = PolicyServer(
+                agent=agent,
+                registry=registry,
+                example_obs=example_obs,
+                max_clients=max_clients,
+                max_batch=max_batch,
+                max_wait_s=max_wait_s,
+                dtype=dtype,
+                seed=seed + i,
+                telemetry=reg,
+                tracer=self._tracer,
+            )
+            self._replicas.append(
+                Replica(f"r{i}", server, registry, weights[i])
+            )
+        self._m_pick = reg.counter("serving/route_pick_total")
+        self._m_retry = reg.counter("serving/route_retry_total")
+        self._m_failover = reg.counter("serving/route_failover_total")
+        self._m_latency = reg.histogram("serving/route_latency_ms")
+        self._m_rollouts = reg.counter("serving/fleet_rollout_total")
+        reg.gauge(
+            "serving/fleet_active", fn=lambda: self._count_state(ACTIVE)
+        )
+        reg.gauge(
+            "serving/fleet_draining",
+            fn=lambda: self._count_state(DRAINING),
+        )
+        reg.gauge(
+            "serving/fleet_dead", fn=lambda: self._count_state(DEAD)
+        )
+        reg.gauge(
+            "serving/route_inflight",
+            fn=lambda: sum(r.inflight for r in self._replicas),
+        )
+        reg.gauge(
+            "serving/fleet_latest_published",
+            fn=lambda: self._latest_published,
+        )
+        self._listener = store.add_publish_listener(self._on_publish)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingFleet":
+        for rep in self._replicas:
+            rep.server.start()
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._store.remove_publish_listener(self._listener)
+        for rep in self._replicas:
+            rep.server.close()
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def label(self) -> str:
+        return self._label
+
+    @property
+    def store(self) -> ParamStore:
+        return self._store
+
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    def replica(self, name: str) -> Replica:
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica {name!r}")
+
+    def states(self) -> Dict[str, str]:
+        with self._cond:
+            return {r.name: r.state for r in self._replicas}
+
+    def _count_state(self, state: str) -> int:
+        return sum(1 for r in self._replicas if r.state == state)
+
+    def _on_publish(self, version: int) -> None:
+        with self._cond:
+            self._latest_published = int(version)
+
+    # -- the router --------------------------------------------------------
+
+    def acquire(
+        self,
+        *,
+        exclude: Sequence[str] = (),
+        prefer: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Replica:
+        """Reserve the best ACTIVE replica (weighted least-loaded; see
+        module docstring for the exact order). Blocks while every
+        non-excluded replica is DRAINING; raises ServerClosed once none
+        can ever come back (fleet closed, or all DEAD)."""
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise ServerClosed("fleet is closed")
+                cands = [
+                    r
+                    for r in self._replicas
+                    if r.state == ACTIVE and r.name not in exclude
+                ]
+                if cands:
+                    pick = None
+                    if prefer is not None:
+                        for r in cands:
+                            if r.name == prefer:
+                                pick = r
+                                break
+                    if pick is None:
+                        pick = min(
+                            cands,
+                            key=lambda r: (
+                                (r.inflight + 1.0) / r.weight,
+                                -r.weight,
+                                r.name,
+                            ),
+                        )
+                    pick.inflight += 1
+                    self._m_pick.inc()
+                    return pick
+                if not any(
+                    r.state == DRAINING and r.name not in exclude
+                    for r in self._replicas
+                ):
+                    raise ServerClosed(
+                        "no live replica: "
+                        f"{ {r.name: r.state for r in self._replicas} }"
+                    )
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    states = {r.name: r.state for r in self._replicas}
+                    raise TimeoutError(
+                        f"no ACTIVE replica within timeout ({states})"
+                    )
+                self._cond.wait(
+                    0.1 if remaining is None else min(remaining, 0.1)
+                )
+
+    def release(
+        self,
+        rep: Replica,
+        latency_ms: Optional[float] = None,
+        ok: bool = True,
+    ) -> None:
+        """Return a reservation; feeds the EWMA on success."""
+        with self._cond:
+            rep.inflight = max(0, rep.inflight - 1)
+            if ok and latency_ms is not None:
+                self._m_latency.observe(latency_ms)
+                rep.ewma_ms = (
+                    latency_ms
+                    if rep.ewma_ms is None
+                    else self._alpha * latency_ms
+                    + (1.0 - self._alpha) * rep.ewma_ms
+                )
+            self._cond.notify_all()
+
+    def mark_dead(self, rep: Replica, reason: str = "") -> None:
+        """Fail a replica over: it is never picked again."""
+        with self._cond:
+            if rep.state == DEAD:
+                return
+            rep.state = DEAD
+            self._m_failover.inc()
+            self._cond.notify_all()
+        self._tracer.instant(
+            "serving/failover", {"replica": rep.name, "reason": reason}
+        )
+
+    # -- draining rollouts -------------------------------------------------
+
+    def rollout(
+        self,
+        version: Optional[int] = None,
+        *,
+        timeout_s: float = 30.0,
+    ) -> Dict[str, Any]:
+        """Deploy `version` (default: the store's latest publish) across
+        the fleet, one replica at a time: DRAIN (no new picks) → wait
+        for its in-flight + queued work to quiesce → re-pin the fleet
+        label on its registry → WARM the new version's serving-dtype
+        params (quantize/cast off-rotation, so the replica returns to
+        traffic hot) → back to rotation. Requests in flight finish on
+        the old version; requests routed during the drain go to the
+        other replicas (or wait, on a 1-replica fleet) — zero drops by
+        construction. Returns {version, replicas} rolled."""
+        if version is None:
+            version = self._store.get(timeout=timeout_s)[0]
+        version = int(version)
+        self._store.get_version(version)  # validate retained up front
+        deadline = time.monotonic() + timeout_s
+        rolled: List[str] = []
+        for rep in list(self._replicas):
+            with self._cond:
+                if rep.state != ACTIVE:
+                    continue
+                rep.state = DRAINING
+                self._cond.notify_all()
+            self._tracer.instant(
+                "serving/rollout",
+                {"phase": "drain", "replica": rep.name, "version": version},
+            )
+            try:
+                self._wait_quiesced(rep, deadline)
+                rep.registry.pin(self._label, version)
+                self._tracer.instant(
+                    "serving/rollout",
+                    {"phase": "pin", "replica": rep.name, "version": version},
+                )
+                rep.server.warm(version)
+                self._tracer.instant(
+                    "serving/rollout",
+                    {"phase": "warm", "replica": rep.name, "version": version},
+                )
+            finally:
+                with self._cond:
+                    if rep.state == DRAINING:
+                        rep.state = ACTIVE
+                    self._cond.notify_all()
+            self._tracer.instant(
+                "serving/rollout",
+                {"phase": "return", "replica": rep.name, "version": version},
+            )
+            rolled.append(rep.name)
+        self._m_rollouts.inc()
+        return {"version": version, "replicas": rolled}
+
+    def _wait_quiesced(self, rep: Replica, deadline: float) -> None:
+        """Block until `rep` owns no in-flight reservations and its
+        server's pending queue is empty (polled — queued work drains on
+        the replica's own serve thread)."""
+        with self._cond:
+            while True:
+                if rep.inflight == 0 and rep.server.pending_count == 0:
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"replica {rep.name} did not quiesce "
+                        f"(inflight={rep.inflight}, "
+                        f"pending={rep.server.pending_count})"
+                    )
+                self._cond.wait(min(remaining, 0.05))
+
+
+class FleetClient:
+    """One logical client over the fleet router.
+
+    Per-request routing by default (`sticky=True` prefers the last
+    replica while it stays ACTIVE — the right mode for recurrent
+    policies, whose carry lives on one replica). Connections to each
+    replica are opened lazily and cached; a replica death invalidates
+    its cached connection and the request retries once elsewhere.
+    """
+
+    def __init__(
+        self,
+        fleet: ServingFleet,
+        greedy: bool = True,
+        timeout_s: float = 30.0,
+        client_id: Optional[int] = None,
+        sticky: bool = False,
+    ) -> None:
+        self._fleet = fleet
+        self._greedy = greedy
+        self._timeout_s = timeout_s
+        self._client_id = client_id
+        self._sticky = sticky
+        self._last_replica: Optional[str] = None
+        self._clients: Dict[str, InProcessClient] = {}
+        self._closed = False
+
+    def _client_for(self, rep: Replica) -> InProcessClient:
+        client = self._clients.get(rep.name)
+        if client is None or client.server is not rep.server:
+            client = InProcessClient(
+                rep.server,
+                greedy=self._greedy,
+                timeout_s=self._timeout_s,
+                client_id=self._client_id,
+            )
+            self._clients[rep.name] = client
+        return client
+
+    def _drop_client(self, rep: Replica) -> None:
+        client = self._clients.pop(rep.name, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    def act_full(
+        self,
+        obs: np.ndarray,
+        first: bool,
+        deadline_s: Optional[float] = None,
+    ) -> FleetResult:
+        """Route one request; on replica death retry ON ANOTHER REPLICA
+        exactly once (first=True — the carry died with the replica).
+        DeadlineExpired never retries: the answer would be just as
+        late."""
+        exclude: List[str] = []
+        last_err: Optional[ServingError] = None
+        for attempt in (0, 1):
+            rep = self._fleet.acquire(
+                exclude=exclude,
+                prefer=self._last_replica if self._sticky else None,
+                timeout_s=self._timeout_s,
+            )
+            t0 = time.monotonic()
+            try:
+                client = self._client_for(rep)
+                res = client.act_async(
+                    obs, first or attempt > 0, deadline_s=deadline_s
+                ).result(self._timeout_s)
+            except ServerClosed as e:
+                self._fleet.release(rep, ok=False)
+                self._drop_client(rep)
+                self._fleet.mark_dead(rep, reason=repr(e))
+                last_err = e
+            except ClientDisconnected as e:
+                # Stale slot (not a dead server): reconnect elsewhere.
+                self._fleet.release(rep, ok=False)
+                self._drop_client(rep)
+                last_err = e
+            except DeadlineExpired:
+                self._fleet.release(rep, ok=False)
+                raise
+            except Exception:
+                self._fleet.release(rep, ok=False)
+                raise
+            else:
+                self._fleet.release(
+                    rep, (time.monotonic() - t0) * 1e3, ok=True
+                )
+                self._last_replica = rep.name
+                return FleetResult(
+                    action=res.action,
+                    version=res.version,
+                    label=res.label,
+                    wave=res.wave,
+                    replica=rep.name,
+                    retried=attempt > 0,
+                )
+            exclude.append(rep.name)
+            if attempt == 0:
+                self._m_note_retry()
+        assert last_err is not None
+        raise last_err
+
+    def _m_note_retry(self) -> None:
+        self._fleet._m_retry.inc()
+
+    def act(self, obs: np.ndarray, first: bool) -> int:
+        """Blocking request returning just the action int — the
+        evaluator-facing surface (run_episodes(client=...))."""
+        return self.act_full(obs, first).action
+
+    def act_abandon(self, obs: np.ndarray, first: bool = True) -> None:
+        """Submit a request, then disconnect before reading the answer —
+        the load generator's disconnect-chaos surface. Exercises the
+        server's ClientDisconnected cleanup without wedging a slot."""
+        rep = self._fleet.acquire(timeout_s=self._timeout_s)
+        try:
+            client = self._client_for(rep)
+            client.act_async(obs, first)
+            self._drop_client(rep)
+        finally:
+            self._fleet.release(rep, ok=False)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for name in list(self._clients):
+            client = self._clients.pop(name)
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "FleetClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

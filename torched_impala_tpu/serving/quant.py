@@ -1,0 +1,161 @@
+"""int8 serving weights: per-channel quantization behind the parity gate.
+
+The actor-side memory/bandwidth lever one notch past bf16: weights are
+stored as int8 with a per-output-channel f32 scale and dequantized
+INSIDE the jitted wave step, so the wave fn retraces once for the int8
+pytree structure and the device only ever holds 1 byte per weight plus
+one f32 per channel.
+
+Which leaves quantize — and along which axis — is keyed by a
+glob → channel-axis layout map over flattened param paths, the same
+shape as a sharding map over named params: integer path components
+(list indices, scan stacks) normalize to ``*`` so one ``*/kernel``
+entry covers every layer. Leaves that match no quantizing entry (biases,
+LayerNorm scales, int counters) pass through in their original dtype.
+
+The math is symmetric round-to-nearest: per channel ``c``,
+``scale_c = max|w_c| / 127`` (floored so all-zero channels stay
+finite), ``q = clip(round(w / scale), -127, 127)``. Symmetric means no
+zero-points to carry and greedy argmax is unaffected by the (positive)
+per-channel rescale error direction.
+
+Policy — identical to bf16 (docs/SERVING.md): int8 serving must pass
+the f32 greedy-action parity gate (`greedy_action_parity(dtype="int8")`
+in serving/server.py, run by doctor/tests/run.py) before a fleet trusts
+it; run.py refuses `--serve-dtype int8` with a nonzero rc on mismatch.
+`corrupt_scales` seeds the failure the gate must catch: it flips the
+sign of alternating channels (a pure gain corruption could slip past
+argmax on a bias-free ReLU net — a sign flip cannot).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# glob over "/"-joined param paths (ints -> "*") -> channel axis to
+# scale along (None = do not quantize). First match wins; no match
+# falls through to DEFAULT (pass through). Mirrors the sharding-map
+# idiom: one "*/kernel" row covers Dense_0 ... Dense_N.
+QUANT_LAYOUT: Tuple[Tuple[str, Optional[int]], ...] = (
+    ("*/kernel", -1),  # Dense/conv kernels: per-output-channel
+    ("*/embedding", -1),
+    ("*/bias", None),
+    ("*/scale", None),  # LayerNorm/BatchNorm gains stay f32
+)
+_SCALE_FLOOR = 1e-8
+
+
+class Int8Params(NamedTuple):
+    """Quantized param pytree: `q` mirrors the original tree (int8 for
+    quantized leaves, original dtype for pass-through leaves), `scale`
+    mirrors it again with broadcastable f32 scales (a scalar 1.0 dummy
+    on pass-through leaves so the two trees always zip)."""
+
+    q: Any
+    scale: Any
+
+
+def _path_str(path) -> str:
+    """Flattened key path -> "/"-joined glob subject, ints -> "*"."""
+    parts = []
+    for entry in path:
+        key = getattr(
+            entry, "key", getattr(entry, "name", getattr(entry, "idx", None))
+        )
+        if key is None:
+            key = str(entry)
+        parts.append("*" if isinstance(key, int) else str(key))
+    return "/".join(parts)
+
+
+def quant_axis_for(
+    path_str: str,
+    layout: Tuple[Tuple[str, Optional[int]], ...] = QUANT_LAYOUT,
+) -> Optional[int]:
+    """Channel axis for a flattened param path, or None (pass through)."""
+    for pattern, axis in layout:
+        if fnmatch.fnmatchcase(path_str, pattern):
+            return axis
+    return None
+
+
+def quantize_params(
+    params: Any,
+    layout: Tuple[Tuple[str, Optional[int]], ...] = QUANT_LAYOUT,
+) -> Int8Params:
+    """Per-channel symmetric int8 quantization of the leaves `layout`
+    selects; everything else passes through untouched."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    q_leaves = []
+    s_leaves = []
+    for path, leaf in flat:
+        axis = quant_axis_for(_path_str(path), layout)
+        arr = jnp.asarray(leaf)
+        if (
+            axis is None
+            or arr.ndim == 0
+            or not jnp.issubdtype(arr.dtype, jnp.floating)
+        ):
+            q_leaves.append(leaf)
+            s_leaves.append(jnp.float32(1.0))
+            continue
+        ax = axis % arr.ndim
+        reduce_axes = tuple(i for i in range(arr.ndim) if i != ax)
+        w = arr.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+        scale = jnp.maximum(amax / 127.0, _SCALE_FLOOR)
+        q = jnp.clip(jnp.round(w / scale), -127.0, 127.0).astype(jnp.int8)
+        q_leaves.append(q)
+        s_leaves.append(scale)
+    return Int8Params(
+        q=jax.tree_util.tree_unflatten(treedef, q_leaves),
+        scale=jax.tree_util.tree_unflatten(treedef, s_leaves),
+    )
+
+
+def dequantize_params(qp: Int8Params) -> Any:
+    """f32 reconstruction (jit-safe: called inside the wave fn)."""
+
+    def leaf(q, s):
+        if q.dtype == jnp.int8:
+            return q.astype(jnp.float32) * s
+        return q
+
+    return jax.tree.map(leaf, qp.q, qp.scale)
+
+
+def corrupt_scales(qp: Int8Params, factor: float = 32.0) -> Int8Params:
+    """Seeded corruption for the parity gate to catch: flip the sign of
+    every other channel and blow the magnitude up by `factor` on every
+    quantized leaf's scale tree. Deterministic, RNG-free."""
+
+    def leaf(q, s):
+        if getattr(q, "dtype", None) != jnp.int8:
+            return s
+        s = jnp.asarray(s)
+        flip = (jnp.arange(s.size).reshape(s.shape) % 2) * (-2.0) + 1.0
+        return s * flip * factor
+
+    return Int8Params(q=qp.q, scale=jax.tree.map(leaf, qp.q, qp.scale))
+
+
+def quantization_report(qp: Int8Params) -> Dict[str, Any]:
+    """Small structured summary (doctor/tests): leaf counts + bytes."""
+    q_leaves = jax.tree.leaves(qp.q)
+    quantized = [a for a in q_leaves if a.dtype == jnp.int8]
+    return {
+        "leaves": len(q_leaves),
+        "quantized_leaves": len(quantized),
+        "int8_bytes": int(sum(a.size for a in quantized)),
+        "scale_bytes": int(
+            sum(
+                4 * a.size
+                for a in jax.tree.leaves(qp.scale)
+                if getattr(a, "ndim", 0) > 0
+            )
+        ),
+    }

@@ -115,6 +115,22 @@ class VersionRegistry:
         with self._lock:
             return dict(self._labels)
 
+    def pinned_version(self, label: str) -> int:
+        """The version `label` is pinned to (KeyError when unpinned) —
+        the fleet router's cheap per-replica version probe."""
+        with self._lock:
+            try:
+                return self._labels[label]
+            except KeyError:
+                raise KeyError(
+                    f"label {label!r} not pinned (have "
+                    f"{sorted(self._labels)})"
+                ) from None
+
+    @property
+    def store(self) -> ParamStore:
+        return self._store
+
     # -- routing -----------------------------------------------------------
 
     def set_routing(

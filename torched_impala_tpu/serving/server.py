@@ -31,11 +31,12 @@ Core mechanics (docs/SERVING.md has the diagrams):
   best-effort background thread (bounded queue, drop-when-busy) — actions
   are logged (`serving/shadow_mismatch`) and NEVER returned, and the
   primary wave path never blocks on shadow compute.
-- bf16 SERVING: `dtype="bfloat16"` casts each pinned version's floating
-  params once (cached per version) — the actor-side speed/memory lever.
-  Policy: bf16 serving must pass the f32 greedy-action parity gate
-  (`greedy_action_parity`, run by doctor/tests/bench) before a fleet
-  trusts it.
+- REDUCED-PRECISION SERVING: `dtype="bfloat16"` casts each pinned
+  version's floating params once (cached per version); `dtype="int8"`
+  quantizes them per-channel (serving/quant.py) and dequantizes inside
+  the jitted wave — the actor-side speed/memory levers. Policy: both
+  must pass the f32 greedy-action parity gate (`greedy_action_parity`,
+  run by doctor/tests/bench/run.py) before a fleet trusts them.
 
 Every request carries a lineage ID (`c<slot>r<seq>`) recorded on the
 `serving/request` span; waves record `serving/wave` with the exact
@@ -56,6 +57,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from torched_impala_tpu.models.agent import Agent
+from torched_impala_tpu.serving.quant import (
+    Int8Params,
+    dequantize_params,
+    quantize_params,
+)
 from torched_impala_tpu.serving.registry import VersionRegistry
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
 from torched_impala_tpu.telemetry.tracing import (
@@ -177,12 +183,16 @@ def greedy_action_parity(
     params: Any,
     obs_batch: np.ndarray,
     dtype="bfloat16",
+    cast_fn=None,
 ) -> tuple[bool, int]:
-    """The bf16 parity gate (docs/SERVING.md): greedy (argmax) actions
-    from `dtype`-cast params must equal the f32 actions on `obs_batch`
-    (fresh initial state, first=True rows). Returns (ok, mismatches).
-    RNG-free by construction — argmax needs no key, so the gate is
-    deterministic."""
+    """The reduced-precision parity gate (docs/SERVING.md): greedy
+    (argmax) actions from the `dtype` serving representation must equal
+    the f32 actions on `obs_batch` (fresh initial state, first=True
+    rows). Returns (ok, mismatches). `dtype="int8"` gates the
+    quantize→dequantize roundtrip (serving/quant.py) through the SAME
+    comparison bf16 uses; `cast_fn` overrides the representation
+    entirely (doctor seeds corrupted scales through it). RNG-free by
+    construction — argmax needs no key, so the gate is deterministic."""
     B = int(obs_batch.shape[0])
     first = jnp.ones((B,), jnp.bool_)
     state = agent.initial_state(B)
@@ -193,8 +203,13 @@ def greedy_action_parity(
         out = agent.step(p, key, obs_batch, first, state)
         return jnp.argmax(out.policy_logits, axis=-1)
 
+    if cast_fn is None:
+        if dtype == "int8":
+            cast_fn = lambda p: dequantize_params(quantize_params(p))  # noqa: E731
+        else:
+            cast_fn = lambda p: cast_params(p, dtype)  # noqa: E731
     a_ref = np.asarray(_greedy(params))
-    a_cast = np.asarray(_greedy(cast_params(params, dtype)))
+    a_cast = np.asarray(_greedy(cast_fn(params)))
     mismatches = int(np.sum(a_ref != a_cast))
     return mismatches == 0, mismatches
 
@@ -227,10 +242,10 @@ class PolicyServer:
             raise ValueError("need max_clients >= 1 and max_batch >= 1")
         if max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
-        if dtype not in ("float32", "bfloat16"):
+        if dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
-                f"unknown serving dtype {dtype!r}; expected 'float32' "
-                "or 'bfloat16'"
+                f"unknown serving dtype {dtype!r}; expected 'float32', "
+                "'bfloat16' or 'int8'"
             )
         self._agent = agent
         self._registry = registry
@@ -251,6 +266,10 @@ class PolicyServer:
         self._free_slots = list(range(max_clients - 1, -1, -1))
         self._pending_resets: List[int] = []
         self._closed = False
+        self._killed = False
+        # Chaos/fleet hook: called (with the server) at the top of every
+        # wave execution; the injector wires faults through it.
+        self.chaos_hook = None  # lint: guarded-by(gil)
         # One servicer at a time: the serve thread normally, a test's
         # service_once() otherwise — the recurrent-state pytree and the
         # wave RNG key are only ever touched under this lock.
@@ -262,11 +281,15 @@ class PolicyServer:
         self._init_row = agent.initial_state(1)
         self._wave_fn = self._build_wave_fn()
         self._wave_seq = 0
-        # version -> cast params (dtype="bfloat16" only); bounded like
-        # the store's retention ring so dead versions don't pin host/HBM.
+        # version -> cast/quantized params (bfloat16/int8 only); bounded
+        # like the store's retention ring so dead versions don't pin
+        # host/HBM. Own lock: `warm()` must be able to populate it while
+        # the serve thread idles inside `_form_wave` holding
+        # `_service_lock`.
         self._cast_cache: "collections.OrderedDict[int, Any]" = (
             collections.OrderedDict()
         )
+        self._cast_lock = threading.Lock()
 
         # Shadow scoring: bounded handoff + one best-effort thread. The
         # primary path only ever does a non-blocking put.
@@ -281,6 +304,7 @@ class PolicyServer:
         self._m_request_dropped = reg.counter("serving/request_dropped")
         self._m_request_wait = reg.histogram("serving/request_wait_ms")
         self._m_wave_total = reg.counter("serving/wave_total")
+        self._m_wave_failed = reg.counter("serving/wave_failed")
         self._m_wave_ms = reg.histogram("serving/wave_ms")
         self._m_wave_size = reg.histogram(
             "serving/wave_size",
@@ -334,6 +358,25 @@ class PolicyServer:
     @property
     def registry(self) -> VersionRegistry:
         return self._registry
+
+    @property
+    def dtype(self) -> str:
+        return self._dtype
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def killed(self) -> bool:
+        return self._killed
+
+    @property
+    def pending_count(self) -> int:
+        """Requests queued but not yet taken into a wave (the fleet's
+        drain loop polls this alongside its own in-flight count)."""
+        with self._cond:
+            return len(self._pending)
 
     def start(self) -> "PolicyServer":
         """Spawn the serving thread (idempotent)."""
@@ -458,21 +501,39 @@ class PolicyServer:
                 return 0
             return self._run_wave(reqs)
 
-    def close(self) -> None:
-        """Stop serving; every outstanding request fails ServerClosed."""
+    def kill(self, reason: str = "killed") -> None:
+        """Abrupt death (chaos `kill_server_mid_wave`, failed waves):
+        fail everything pending and stop, WITHOUT joining threads — so
+        it is safe to call from the serve thread itself mid-wave. The
+        fleet router sees ServerClosed surface on the clients and fails
+        the replica over; `close()` afterwards still joins cleanly."""
         with self._cond:
             if self._closed:
                 return
             self._closed = True
+            self._killed = True
             pending = list(self._pending)
+            self._pending.clear()
+            self._cond.notify_all()
+        for req in pending:
+            req.cell.fail(ServerClosed(f"server killed: {reason}"))
+        self._shadow_evt.set()
+
+    def close(self) -> None:
+        """Stop serving; every outstanding request fails ServerClosed."""
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            pending = [] if already else list(self._pending)
             self._pending.clear()
             self._cond.notify_all()
         for req in pending:
             req.cell.fail(ServerClosed("server closed"))
         self._shadow_evt.set()
-        if self._thread is not None:
+        cur = threading.current_thread()
+        if self._thread is not None and self._thread is not cur:
             self._thread.join(timeout=10)
-        if self._shadow_thread is not None:
+        if self._shadow_thread is not None and self._shadow_thread is not cur:
             self._shadow_thread.join(timeout=10)
 
     # -- wave formation ----------------------------------------------------
@@ -565,6 +626,11 @@ class PolicyServer:
         max_clients = self._max_clients
 
         def _wave(params, key, obs, first, idx, state):
+            if isinstance(params, Int8Params):
+                # Python-level branch: jit retraces once for the int8
+                # pytree structure; the device holds int8 + f32 scales
+                # and reconstructs f32 weights inside the compiled wave.
+                params = dequantize_params(params)
             key, sub = jax.random.split(key)
             gather = jnp.minimum(idx, max_clients - 1)
             rows = jax.tree.map(lambda a: a[gather], state)
@@ -583,26 +649,65 @@ class PolicyServer:
 
         return jax.jit(_wave)
 
-    def _params_for(self, version: int, params: Any) -> Any:  # lint: guarded-by(_service_lock)
+    def _params_for(self, version: int, params: Any) -> Any:  # lint: guarded-by(_cast_lock)
         if self._dtype == "float32":
             return params
-        cached = self._cast_cache.get(version)
-        if cached is None:
-            cached = cast_params(params, jnp.bfloat16)
-            self._cast_cache[version] = cached
-            while len(self._cast_cache) > 4:
-                self._cast_cache.popitem(last=False)
-        return cached
+        with self._cast_lock:
+            cached = self._cast_cache.get(version)
+            if cached is None:
+                if self._dtype == "int8":
+                    cached = quantize_params(params)
+                else:
+                    cached = cast_params(params, jnp.bfloat16)
+                self._cast_cache[version] = cached
+                while len(self._cast_cache) > 4:
+                    self._cast_cache.popitem(last=False)
+            return cached
+
+    def warm(self, version: int) -> None:
+        """Pre-resolve `version`'s serving-dtype params into the cast
+        cache, so the quantize/cast cost lands NOW instead of inside
+        the first wave at the new version. Draining rollouts
+        (fleet.rollout) call this while the replica is still out of
+        rotation: with a second replica carrying traffic the warm is
+        free, with one replica it is downtime — the availability gap
+        bench.py's loadgen section measures. No-op for float32."""
+        if self._dtype == "float32":
+            return
+        params = self._registry.store.get_version(version)
+        self._params_for(version, params)
 
     def _run_wave(self, reqs: List[_Request]) -> int:
         """Execute one wave per label group in `reqs`; returns requests
-        answered. Must be called with `_service_lock` held."""
+        answered. Must be called with `_service_lock` held.
+
+        A wave that RAISES (corrupted pinned params, device loss, chaos)
+        must not wedge its clients on cells nobody will ever write: the
+        group's cells fail with ServerClosed and the server kills itself
+        so the fleet router fails the replica over instead of feeding it
+        more traffic."""
+        hook = self.chaos_hook
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:
+                pass  # chaos acts through explicit effects, never raises
         groups: Dict[str, List[_Request]] = {}
         for req in reqs:
             groups.setdefault(req.label, []).append(req)
         served = 0
         for label, group in groups.items():
-            served += self._run_label_wave(label, group)
+            if self._closed:
+                for req in group:
+                    req.cell.fail(ServerClosed("server killed mid-wave"))
+                continue
+            try:
+                served += self._run_label_wave(label, group)
+            except Exception as e:
+                self._m_wave_failed.inc()
+                for req in group:
+                    req.cell.fail(ServerClosed(f"wave failed: {e!r}"))
+                self.kill(reason=f"wave execution failed: {e!r}")
         return served
 
     def _run_label_wave(self, label: str, group: List[_Request]) -> int:  # lint: guarded-by(_service_lock)

@@ -238,6 +238,8 @@ class ShmRingPump:
     def __init__(self, server, poll_s: float = 5e-5) -> None:
         self._server = server
         self._poll_s = poll_s
+        # Chaos hook (wedge_shm_ring): called once per pump scan.
+        self.chaos_hook = None  # lint: guarded-by(gil)
         self._lock = threading.Lock()
         # ring -> [server slot, next absolute index, in-flight slot set]
         self._rings: Dict[ShmServingRing, list] = {}
@@ -282,6 +284,9 @@ class ShmRingPump:
     def _pump_once(self) -> bool:  # lint: hot-loop
         """One scan: submit new REQUEST slots, write back finished cells.
         Returns True when any work happened."""
+        hook = self.chaos_hook
+        if hook is not None:
+            hook(self)
         busy = False
         with self._lock:
             rings = list(self._rings.items())
