@@ -1,0 +1,16 @@
+"""BAD fixture for sharding/feed-path-placement: a runtime/ module
+constructing feed-path shardings ad hoc instead of resolving them
+through SpecLayout's BATCH_PLACEMENT builders. test_lint scans this
+body under a torched_impala_tpu/runtime/ rel path."""
+
+import jax
+from jax.sharding import NamedSharding
+
+from torched_impala_tpu.parallel import spec_layout
+
+
+def put_batch(mesh, arrays):
+    # ad-hoc per-call sharding on the feed path: the placement no
+    # longer resolves through the canonical table
+    sh = NamedSharding(mesh, spec_layout.batch_spec())
+    return jax.device_put(arrays, sh)

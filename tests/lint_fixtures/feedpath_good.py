@@ -1,0 +1,10 @@
+"""GOOD fixture for sharding/feed-path-placement: a runtime/ module
+whose batch shardings resolve through SpecLayout's batch-placement
+builders — no NamedSharding construction on the feed path."""
+
+from torched_impala_tpu.parallel import multihost, spec_layout
+
+
+def put_batch(mesh, arrays, fused):
+    shardings = spec_layout.feed_shardings(mesh, superbatch=fused)
+    return multihost.place_batch(shardings, arrays)

@@ -20,17 +20,26 @@ from torched_impala_tpu.runtime import Learner, LearnerConfig, VectorActor
 from torched_impala_tpu.telemetry.registry import Registry
 
 
-def _agent():
+def _agent(num_values=1):
     return Agent(
-        ImpalaNet(num_actions=2, torso=MLPTorso(hidden_sizes=(16,)))
+        ImpalaNet(
+            num_actions=2,
+            torso=MLPTorso(hidden_sizes=(16,)),
+            num_values=num_values,
+        )
     )
 
 
-def _run_ring(donate, K=1, n=4, T=3, B=4, E=2):
+def _run_ring(donate, K=1, n=4, T=3, B=4, E=2, mesh=None, **cfg_kwargs):
     """Train `n` learner steps through the trajectory ring and return
-    (final params, telemetry registry)."""
+    (final params, telemetry registry, per-step losses)."""
     reg = Registry()
-    agent = _agent()
+    num_values = (
+        cfg_kwargs["popart"].num_values
+        if cfg_kwargs.get("popart") is not None
+        else 1
+    )
+    agent = _agent(num_values)
     learner = Learner(
         agent=agent,
         optimizer=optax.sgd(1e-2),
@@ -40,10 +49,12 @@ def _run_ring(donate, K=1, n=4, T=3, B=4, E=2):
             traj_ring=True,
             steps_per_dispatch=K,
             donate_batch=donate,
+            **cfg_kwargs,
         ),
         example_obs=np.zeros((4,), np.float32),
         rng=jax.random.key(0),
         telemetry=reg,
+        mesh=mesh,
     )
     envs = [ScriptedEnv(episode_len=4) for _ in range(E)]
     actor = VectorActor(
@@ -57,26 +68,28 @@ def _run_ring(donate, K=1, n=4, T=3, B=4, E=2):
         traj_ring=learner.traj_ring,
     )
     learner.start()
+    losses = []
     try:
         for _ in range(n):
             for _ in range(K * B // E):
                 actor.unroll_and_push()
             logs = learner.step_once(timeout=60)
             assert np.isfinite(logs["total_loss"])
+            losses.append(float(logs["total_loss"]))
     finally:
         learner.stop()
     params = jax.tree.map(
         lambda x: np.array(x, copy=True), learner.params
     )
-    return params, reg
+    return params, reg, losses
 
 
 class TestDonatedRing:
     def test_params_bit_identical_to_copy_path(self):
         """Donation is pure aliasing: same batches, same math, same
         bits — and zero host staging copies."""
-        p_copy, reg_copy = _run_ring(donate=False)
-        p_don, reg_don = _run_ring(donate=True)
+        p_copy, reg_copy, _ = _run_ring(donate=False)
+        p_don, reg_don, _ = _run_ring(donate=True)
         jax.tree.map(np.testing.assert_array_equal, p_copy, p_don)
         # The copy path stages every batch through host memory; the
         # donated path must stage NOTHING.
@@ -87,13 +100,13 @@ class TestDonatedRing:
     def test_superbatch_donated_parity(self):
         """K=2 superbatch slots feed the fused dispatch directly;
         donation must not change the training trajectory."""
-        p_copy, _ = _run_ring(donate=False, K=2, n=3)
-        p_don, reg = _run_ring(donate=True, K=2, n=3)
+        p_copy, _, _ = _run_ring(donate=False, K=2, n=3)
+        p_don, reg, _ = _run_ring(donate=True, K=2, n=3)
         jax.tree.map(np.testing.assert_array_equal, p_copy, p_don)
         assert reg.counter("learner/ring_stage_bytes").value == 0
 
     def test_h2d_overlap_telemetry_populated(self):
-        _, reg = _run_ring(donate=True)
+        _, reg, _ = _run_ring(donate=True)
         assert reg.counter("perf/h2d_ns_total").value > 0
         frac = reg.gauge("perf/h2d_overlap_frac").value
         assert 0.0 <= frac <= 1.0
@@ -120,6 +133,106 @@ class TestDonatedRing:
                 ),
                 **common,
             )
+
+    def test_mesh_feed_parity_with_single_device(self):
+        """Sharded-vs-single-device feed parity (ISSUE 15): the same
+        seeded run through a 2-device CPU mesh produces allclose losses
+        for 3 steps — the per-shard placement is the same batch, same
+        math, just partitioned."""
+        from torched_impala_tpu.parallel import make_mesh
+
+        _, _, single = _run_ring(donate=False, n=3)
+        mesh = make_mesh(num_data=2, devices=jax.devices("cpu")[:2])
+        _, reg, meshed = _run_ring(donate=False, n=3, mesh=mesh)
+        np.testing.assert_allclose(single, meshed, rtol=1e-4)
+        # h2d overlap telemetry is credited per shard under the mesh.
+        assert reg.counter("perf/h2d_ns_total").value > 0
+        frac = reg.gauge("perf/h2d_overlap_frac").value
+        assert 0.0 <= frac <= 1.0
+
+    def test_mesh_donated_ring_zero_staging(self):
+        """Under the mesh learner the donated ring path stages ZERO
+        bytes host-side (the acceptance gauge: learner/ring_stage_bytes
+        == 0) and every batch is donated into the pjit step."""
+        from torched_impala_tpu.parallel import make_mesh
+
+        mesh = make_mesh(num_data=2, devices=jax.devices("cpu")[:2])
+        _, reg, losses = _run_ring(donate=True, n=3, mesh=mesh)
+        assert len(losses) == 3
+        assert reg.counter("learner/ring_stage_bytes").value == 0
+        assert reg.counter("learner/donated_batches").value == 3
+
+    def test_mesh_donation_reuses_slot_backing_stores(self):
+        """Donation aliasing under pjit: a sharded batch assembled by
+        place_batch from per-shard puts is consumed by the donating
+        step — the global array's buffers are handed to XLA (deleted
+        after the call), so ring slot backing stores feed the step with
+        no intermediate copy and recycle for the next batch."""
+        from torched_impala_tpu.parallel import make_mesh
+        from torched_impala_tpu.parallel import multihost, spec_layout
+
+        mesh = make_mesh(num_data=2, devices=jax.devices("cpu")[:2])
+        sh = spec_layout.feed_shardings(mesh)[0]  # obs: [T+1, B, ...]
+        slot = np.ones((4, 2, 3), np.float32)  # stands in for a ring slot
+        placed = multihost.place_batch(sh, slot)
+        assert len(placed.sharding.device_set) == 2
+
+        step = jax.jit(
+            lambda x: x * 2.0,
+            donate_argnums=(0,),
+            in_shardings=sh,
+            out_shardings=sh,
+        )
+        out = step(placed)
+        assert placed.is_deleted()  # buffers donated into the step
+        np.testing.assert_array_equal(np.asarray(out), slot * 2.0)
+        # The ring slot itself (host numpy) is untouched and reusable.
+        np.testing.assert_array_equal(slot, np.ones((4, 2, 3)))
+
+    def test_mesh_replay_and_popart_compose(self):
+        """The lifted carve-outs (ISSUE 15): mesh+replay and
+        mesh+PopArt+replay train end-to-end instead of being refused at
+        config validation."""
+        from torched_impala_tpu.ops.popart import PopArtConfig
+        from torched_impala_tpu.parallel import make_mesh
+        from torched_impala_tpu.replay import ReplayConfig
+
+        mesh = make_mesh(num_data=2, devices=jax.devices("cpu")[:2])
+        _, _, l_replay = _run_ring(
+            donate=False,
+            n=3,
+            mesh=mesh,
+            replay=ReplayConfig(max_reuse=2, target_update_interval=1),
+        )
+        assert len(l_replay) == 3 and all(np.isfinite(l_replay))
+
+        _, _, l_both = _run_ring(
+            donate=False,
+            n=3,
+            mesh=mesh,
+            replay=ReplayConfig(max_reuse=2, target_update_interval=1),
+            popart=PopArtConfig(num_values=2),
+        )
+        assert len(l_both) == 3 and all(np.isfinite(l_both))
+
+    def test_popart_replay_mesh_matches_single_device(self):
+        """PopArt+replay parity across the mesh boundary: the composed
+        step is the same math sharded, so the seeded loss trajectory
+        matches the single-device run."""
+        from torched_impala_tpu.ops.popart import PopArtConfig
+        from torched_impala_tpu.parallel import make_mesh
+        from torched_impala_tpu.replay import ReplayConfig
+
+        kwargs = dict(
+            donate=False,
+            n=3,
+            replay=ReplayConfig(max_reuse=2, target_update_interval=1),
+            popart=PopArtConfig(num_values=2),
+        )
+        _, _, single = _run_ring(**kwargs)
+        mesh = make_mesh(num_data=2, devices=jax.devices("cpu")[:2])
+        _, _, meshed = _run_ring(mesh=mesh, **kwargs)
+        np.testing.assert_allclose(single, meshed, rtol=1e-4)
 
     def test_fused_epilogue_popart_guard(self):
         from torched_impala_tpu.ops.popart import PopArtConfig

@@ -422,7 +422,7 @@ class TestShardingChecker:
     def test_tensor_table_is_self_consistent(self):
         """Every TENSOR_TABLE spec uses only MESH_AXES names — checked
         on the real repo tables (the fallback load path)."""
-        mesh_axes, tensor_table, errs = sharding._load_tables([])
+        mesh_axes, tensor_table, _, errs = sharding._load_tables([])
         assert errs == []
         axes = set(mesh_axes)
         for name, spec in tensor_table.items():
@@ -433,6 +433,48 @@ class TestShardingChecker:
                     entry if isinstance(entry, tuple) else (entry,)
                 )
                 assert set(parts) <= axes, (name, spec)
+
+    def test_batch_placement_table_loads_and_is_consistent(self):
+        """BATCH_ROLES/BATCH_PLACEMENT parse as pure literals (the
+        fallback load path) and every role's logical name resolves
+        against TENSOR_TABLE in both layouts."""
+        _, tensor_table, placement, errs = sharding._load_tables([])
+        assert errs == []
+        roles = placement["__roles__"]
+        assert "obs" in roles and "agent_state" in roles
+        for layout in ("plain", "superbatch"):
+            entries = placement[layout]
+            assert set(entries) == set(roles)
+            for role, (logical, dim) in entries.items():
+                assert logical in tensor_table, (layout, role)
+                assert isinstance(dim, int)
+
+    def _as_runtime(self, name):
+        rel = f"torched_impala_tpu/runtime/{name}"
+        path = os.path.join(FIXTURES, name)
+        with open(path, encoding="utf-8") as f:
+            return SourceFile(f"<{rel}>", rel, f.read())
+
+    def test_feedpath_bad_fixture_fires(self):
+        found = sharding.check([self._as_runtime("feedpath_bad.py")])
+        assert "sharding/feed-path-placement" in rules_of(found)
+        msgs = " | ".join(f.message for f in found)
+        assert "feed_shardings" in msgs
+
+    def test_feedpath_good_fixture_is_clean(self):
+        found = sharding.check([self._as_runtime("feedpath_good.py")])
+        assert found == []
+
+    def test_feedpath_rule_scoped_to_runtime(self):
+        """The same NamedSharding construction outside runtime/ does
+        not trip the feed-path rule (other modules legitimately build
+        shardings from the table's specs)."""
+        rel = "torched_impala_tpu/parallel/other.py"
+        path = os.path.join(FIXTURES, "feedpath_bad.py")
+        with open(path, encoding="utf-8") as f:
+            sf = SourceFile(f"<{rel}>", rel, f.read())
+        found = sharding.check([sf])
+        assert "sharding/feed-path-placement" not in rules_of(found)
 
 
 # ---- interprocedural donation checker (ISSUE 11) -------------------------

@@ -298,11 +298,26 @@ class TestRingPipeline:
         )
         assert sb.traj_ring.superbatch_k == 2
         assert sb.traj_ring._slots[0].buffers.obs.shape == (2, 4, 2, 4)
-        with pytest.raises(ValueError, match="single-device"):
+        # Mesh + ring (ISSUE 15): the single-device carve-out is lifted
+        # — the learner builds the ring and the table-driven feed
+        # shardings instead of refusing.
+        meshed = Learner(
+            config=LearnerConfig(
+                batch_size=2, unroll_length=3, traj_ring=True
+            ),
+            mesh=make_mesh(num_data=2),
+            **common,
+        )
+        assert meshed.traj_ring is not None
+        assert len(meshed._batch_shardings) == 8
+        # data_device stays a genuinely unsupported combo.
+        with pytest.raises(ValueError, match="data_device"):
             Learner(
                 config=LearnerConfig(
-                    batch_size=2, unroll_length=3, traj_ring=True
+                    batch_size=2,
+                    unroll_length=3,
+                    traj_ring=True,
+                    data_device="cpu",
                 ),
-                mesh=make_mesh(num_data=2),
                 **common,
             )

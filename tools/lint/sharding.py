@@ -25,6 +25,13 @@ site disagrees with the layout every other frame assumes. The contract:
 - **arity**: a spec must not name more dimensions than the array it is
   applied to has (tracked for locally-created arrays of known rank).
   [``sharding/spec-arity-mismatch``]
+- **feed-path placement**: modules under ``torched_impala_tpu/runtime/``
+  may not construct ``NamedSharding`` at all — batch shardings resolve
+  through the BATCH_PLACEMENT table's builders
+  (``spec_layout.feed_shardings``/``feed_spec``), and the table itself
+  must be self-consistent (every BATCH_ROLES role in every layout,
+  every logical name in TENSOR_TABLE).
+  [``sharding/feed-path-placement``]
 
 The tables are read with ``ast.literal_eval`` from the spec_layout
 source — no jax import, so the checker runs anywhere tier-1 does.
@@ -57,7 +64,17 @@ RULES = {
     "sharding/no-spec-layout": (
         "SpecLayout table missing or unparsable"
     ),
+    "sharding/feed-path-placement": (
+        "feed-path sharding constructed ad hoc in runtime/ — batch "
+        "shardings must resolve through SpecLayout's batch-placement "
+        "entries (spec_layout.feed_shardings / feed_spec)"
+    ),
 }
+
+# Modules whose device_put/NamedSharding call sites are the learner
+# feed path: constructing a NamedSharding here instead of calling the
+# spec_layout builders bypasses the BATCH_PLACEMENT contract.
+FEED_PATH_PREFIX = "torched_impala_tpu/runtime/"
 
 SPEC_LAYOUT_REL = "torched_impala_tpu/parallel/spec_layout.py"
 
@@ -81,10 +98,17 @@ _SPEC_NAMES = {"PartitionSpec", "P"}
 
 def _load_tables(
     files: Sequence[SourceFile],
-) -> Tuple[Optional[Tuple[str, ...]], Dict[str, tuple], List[Finding]]:
-    """(MESH_AXES, TENSOR_TABLE, findings). Reads the literal tables
-    from the scanned spec_layout.py, falling back to the repo's checked-
-    in copy (fixture runs scan a single file)."""
+) -> Tuple[
+    Optional[Tuple[str, ...]],
+    Dict[str, tuple],
+    Dict[str, dict],
+    List[Finding],
+]:
+    """(MESH_AXES, TENSOR_TABLE, BATCH_PLACEMENT, findings). Reads the
+    literal tables from the scanned spec_layout.py, falling back to the
+    repo's checked-in copy (fixture runs scan a single file). The
+    returned BATCH_PLACEMENT dict carries the parsed BATCH_ROLES tuple
+    under the ``"__roles__"`` key."""
     src = None
     for sf in files:
         if sf.rel == SPEC_LAYOUT_REL:
@@ -96,7 +120,7 @@ def _load_tables(
             with open(path, encoding="utf-8") as f:
                 src = f.read()
     if src is None:
-        return None, {}, [
+        return None, {}, {}, [
             Finding(
                 rule="sharding/no-spec-layout",
                 path=SPEC_LAYOUT_REL,
@@ -107,6 +131,7 @@ def _load_tables(
         ]
     axes: Optional[Tuple[str, ...]] = None
     table: Dict[str, tuple] = {}
+    placement: Dict[str, dict] = {}
     try:
         tree = ast.parse(src)
         for stmt in tree.body:
@@ -122,10 +147,16 @@ def _load_tables(
                     k: tuple(v)
                     for k, v in ast.literal_eval(stmt.value).items()
                 }
+            elif tgt.id == "BATCH_PLACEMENT":
+                placement.update(ast.literal_eval(stmt.value))
+            elif tgt.id == "BATCH_ROLES":
+                placement["__roles__"] = tuple(
+                    ast.literal_eval(stmt.value)
+                )
     except (SyntaxError, ValueError):
         pass
     if axes is None:
-        return None, {}, [
+        return None, {}, {}, [
             Finding(
                 rule="sharding/no-spec-layout",
                 path=SPEC_LAYOUT_REL,
@@ -137,7 +168,7 @@ def _load_tables(
                 key=f"{SPEC_LAYOUT_REL}::literal",
             )
         ]
-    return axes, table, []
+    return axes, table, placement, []
 
 
 def _spec_matches_table(
@@ -301,7 +332,7 @@ def _axis_params_fixpoint(
 
 
 def check(files: Sequence[SourceFile]) -> List[Finding]:
-    axes, table, findings = _load_tables(files)
+    axes, table, placement, findings = _load_tables(files)
     if axes is None:
         return findings
     graph = ipa.build(files)
@@ -332,6 +363,7 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                             key=f"{sf.rel}::table:{name}",
                         )
                     )
+        findings.extend(_check_placement_tables(sf, table, placement))
 
     # Interprocedural: string literals bound at call sites to axis
     # parameters of the callee (1-2 hops of flow computed above).
@@ -382,6 +414,84 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
     return unique
 
 
+def _check_placement_tables(
+    sf: SourceFile,
+    table: Dict[str, tuple],
+    placement: Dict[str, dict],
+) -> List[Finding]:
+    """BATCH_PLACEMENT self-consistency: every declared role has an
+    entry in every layout, and every entry's logical tensor name
+    resolves against TENSOR_TABLE — the invariants feed_shardings and
+    the feed-path rule both rest on."""
+    out: List[Finding] = []
+    roles = placement.get("__roles__", ())
+    layouts = {k: v for k, v in placement.items() if k != "__roles__"}
+    if not roles or not layouts:
+        out.append(
+            Finding(
+                rule="sharding/no-spec-layout",
+                path=sf.rel,
+                line=1,
+                message=(
+                    "BATCH_ROLES/BATCH_PLACEMENT are missing or not "
+                    "pure literals (ast.literal_eval failed)"
+                ),
+                key=f"{sf.rel}::placement-literal",
+            )
+        )
+        return out
+    for layout, entries in layouts.items():
+        for role in roles:
+            if role not in entries:
+                out.append(
+                    Finding(
+                        rule="sharding/feed-path-placement",
+                        path=sf.rel,
+                        line=1,
+                        message=(
+                            f"BATCH_PLACEMENT[{layout!r}] is missing "
+                            f"role {role!r} declared in BATCH_ROLES"
+                        ),
+                        key=f"{sf.rel}::placement-role:{layout}:{role}",
+                    )
+                )
+        for role, entry in entries.items():
+            logical = entry[0] if isinstance(entry, tuple) else None
+            if role not in roles:
+                out.append(
+                    Finding(
+                        rule="sharding/feed-path-placement",
+                        path=sf.rel,
+                        line=1,
+                        message=(
+                            f"BATCH_PLACEMENT[{layout!r}] declares "
+                            f"role {role!r} absent from BATCH_ROLES"
+                        ),
+                        key=(
+                            f"{sf.rel}::placement-extra:{layout}:{role}"
+                        ),
+                    )
+                )
+            if logical not in table:
+                out.append(
+                    Finding(
+                        rule="sharding/feed-path-placement",
+                        path=sf.rel,
+                        line=1,
+                        message=(
+                            f"BATCH_PLACEMENT[{layout!r}][{role!r}] "
+                            f"names logical tensor {logical!r}, not in "
+                            "TENSOR_TABLE"
+                        ),
+                        key=(
+                            f"{sf.rel}::placement-logical:"
+                            f"{layout}:{role}"
+                        ),
+                    )
+                )
+    return out
+
+
 def _check_file(
     sf: SourceFile,
     ctx: _FileCtx,
@@ -422,9 +532,33 @@ def _check_file(
                             (fn_of(node, parents), node.targets[0].id)
                         ] = len(shape.elts)
 
+    in_feed_path = sf.rel.startswith(FEED_PATH_PREFIX)
     for node in ast.walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue
+        # 0. feed-path placement: runtime/ may not construct
+        # NamedSharding at all — batch shardings come from the
+        # SpecLayout batch-placement builders (feed_shardings), so the
+        # per-tensor placement stays declared in ONE table the runtime
+        # and this checker share.
+        if in_feed_path:
+            d0 = ipa.dotted(node.func)
+            if d0 and d0.split(".")[-1] == "NamedSharding":
+                findings.append(
+                    Finding(
+                        rule="sharding/feed-path-placement",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            "NamedSharding constructed on the feed "
+                            "path — use spec_layout.feed_shardings / "
+                            "feed_spec (BATCH_PLACEMENT) so the "
+                            "placement resolves through the canonical "
+                            "table"
+                        ),
+                        key=f"{sf.rel}::feedpath:{node.lineno}",
+                    )
+                )
         # 1. PartitionSpec construction
         if ctx.is_spec_ctor(node):
             findings.append(

@@ -73,6 +73,21 @@ BUDGETS: Dict[str, Dict[str, Any]] = {
         "max": 0.9,
         "fingerprint_contains": "",
     },
+    # ISSUE 15 mesh-native feed. Backend-agnostic: staged bytes under
+    # the 2-device data mesh must be EXACTLY zero (the tentpole claim —
+    # ring slots shard straight to per-device memory with no host
+    # gather/stage hop), and per-batch sharded placement must be no
+    # slower than the explicit stage-on-one-device-then-reshard hop it
+    # replaces (same-box quotient; the hop moves every byte over H2D
+    # twice, measured ~0.6x on CPU).
+    "mesh_ring_stage_bytes": {
+        "max": 0.0,
+        "fingerprint_contains": "",
+    },
+    "mesh_feed_step_ratio": {
+        "max": 1.0,
+        "fingerprint_contains": "",
+    },
     # ISSUE 14 fleet serving. Backend-agnostic: the goodput ratio is a
     # same-box quotient (2-replica fleet vs single server across an
     # incident window with a mid-wave server kill — measured ~1.99x,

@@ -156,7 +156,9 @@ class ExperimentConfig:
     # unrolls straight into preallocated learner batch slots — the
     # shm-lane -> Trajectory -> np.stack copy chain collapses to one
     # write. Opt-in; needs vectorized actors whose env counts divide
-    # batch_size and the single-device K=1 learner (LearnerConfig docs).
+    # batch_size. Composes with the mesh learner (slots are sliced
+    # per-shard at device_put; parallel/multihost.place_batch) and with
+    # the fused K>1 dispatch (LearnerConfig docs).
     traj_ring: bool = False
     # IMPACT replay (torched_impala_tpu/replay/, docs/REPLAY.md): train
     # on each ring slot up to `max_reuse` times with the clipped

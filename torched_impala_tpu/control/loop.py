@@ -213,6 +213,7 @@ def build_train_control(
     checkpointer=None,
     batch_size: Optional[int] = None,
     steps_per_dispatch: Optional[int] = None,
+    data_shards: int = 1,
     interval_s: float = 5.0,
     tolerance: float = 0.05,
     hysteresis: float = 0.01,
@@ -345,15 +346,25 @@ def build_train_control(
 
     gate = RecompileGate(allow=allow_recompile)
     if batch_size:
+        # Under a data-parallel mesh every proposed B must stay
+        # divisible by the data-axis size (the learner refuses a
+        # non-divisible batch at construction), so the grid anchors and
+        # steps in multiples of `data_shards` — per-shard-aware knob
+        # grids (ISSUE 15). data_shards=1 reproduces the old grid.
+        n = max(1, int(data_shards))
+
+        def _q(v: int) -> int:  # round up to a shard multiple, >= n
+            return max(n, ((int(v) + n - 1) // n) * n)
+
         loop.add_knob(
             Knob(
                 KnobSpec(
                     "batch_size",
                     # Grid anchored at B/2 so the live B is a grid
                     # point (lo=1 + step=B/2 quantized 8 -> 9).
-                    lo=max(1, batch_size // 2),
-                    hi=max(2.0, 4.0 * batch_size),
-                    step=max(1, batch_size // 2),
+                    lo=_q(max(1, batch_size // 2)),
+                    hi=max(2.0 * n, 4.0 * batch_size),
+                    step=_q(max(1, batch_size // 2)),
                     kind="int",
                     recompile=True,
                 ),

@@ -36,12 +36,14 @@ import jax.numpy as jnp
 from torched_impala_tpu.ops.losses import (
     ImpalaLossConfig,
     LossOutput,
+    _reduce,
     action_log_probs,
     assemble_loss,
     baseline_loss,
     entropy_loss,
     policy_gradient_loss,
 )
+from torched_impala_tpu.ops.vtrace import clipped_surrogate as _clipped_surrogate
 from torched_impala_tpu.ops.vtrace import vtrace as _vtrace
 
 
@@ -371,6 +373,110 @@ def popart_impala_loss(
         extra_logs={
             "mean_vtrace_target": jnp.mean(vt.vs),
             "mean_advantage": jnp.mean(vt.pg_advantages),
+            "popart_mu_mean": jnp.mean(new_state.mu),
+            "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
+        },
+    )
+    return out, new_state
+
+
+def popart_impact_loss(
+    *,
+    learner_logits: jax.Array,  # [T, B, A] live policy — carries gradient
+    target_logits: jax.Array,  # [T, B, A] pinned target — stop-gradiented
+    behaviour_logits: jax.Array,  # [T, B, A]
+    norm_values: jax.Array,  # [T, B] live normalized V, must carry gradient
+    norm_bootstrap: jax.Array,  # [B] live normalized V(x_T)
+    actions: jax.Array,  # [T, B]
+    rewards: jax.Array,  # [T, B]
+    discounts: jax.Array,  # [T, B]
+    tasks: jax.Array,  # [B] int32
+    state: PopArtState,
+    popart_config: PopArtConfig,
+    clip_epsilon: float = 0.2,
+    config: ImpalaLossConfig = ImpalaLossConfig(),
+    mask: jax.Array | None = None,
+    devices=None,
+) -> tuple[LossOutput, PopArtState]:
+    """IMPACT clipped-target surrogate under PopArt normalization — the
+    composition that lifts the PopArt+replay carve-out (ISSUE 15).
+
+    The replay anchor and the normalization compose orthogonally:
+
+    - V-trace runs in UNNORMALIZED space anchored on the pinned TARGET
+      policy (rho, c, and the pg advantage use pi_target / mu, exactly
+      `losses.impact_loss`), with the live net's normalized values
+      unnormalized under the PRE-update stats as targets — the live
+      baseline is IMPACT's value function, the target net only anchors
+      the policy corrections;
+    - the optimized policy term is the PPO-style clipped surrogate on
+      r = pi_theta / pi_target with advantages divided by the
+      POST-update sigma (the PopArt scale-invariance property);
+    - the baseline regresses the live normalized predictions onto the
+      normalized V-trace targets, both expressed under the POST-update
+      stats, and the per-task EMA update is identical to
+      `popart_impala_loss` — so the caller applies `rescale_params`
+      with the returned (old, new) pair exactly as on the on-policy
+      path. The pinned target params are rescaled per-pin by the
+      TargetParamStore refresh (they are a copy of live params, already
+      rescaled), never in the step.
+
+    Returns (LossOutput, new PopArtState); logs add the `impact_*`
+    drift gauges and the `popart_*` stats gauges.
+    """
+    if mask is None:
+        mask = jnp.ones_like(rewards)
+    mask = mask.astype(norm_values.dtype)
+
+    target_logits = jax.lax.stop_gradient(target_logits)
+    s_old = sigma(state, popart_config)[tasks]  # [B]
+    mu_old = state.mu[tasks]
+
+    vt = _unnormalized_vtrace(
+        target_logits=target_logits,
+        behaviour_logits=behaviour_logits,
+        norm_values=norm_values,
+        norm_bootstrap=norm_bootstrap,
+        actions=actions,
+        rewards=rewards,
+        discounts=discounts,
+        tasks=tasks,
+        state=state,
+        popart_config=popart_config,
+        config=config,
+        devices=devices,
+    )
+
+    new_state = jax.lax.stop_gradient(
+        update(state, popart_config, vt.vs, tasks, mask)
+    )
+    s_new = sigma(new_state, popart_config)[tasks]
+    mu_new = new_state.mu[tasks]
+
+    norm_values_new = (s_old * norm_values + mu_old - mu_new) / s_new
+    norm_targets = (vt.vs - mu_new) / s_new  # already stop-gradiented
+
+    target_lp = action_log_probs(target_logits, actions)
+    log_ratio = action_log_probs(learner_logits, actions) - target_lp
+    surrogate, ratio = _clipped_surrogate(
+        log_ratio, vt.pg_advantages / s_new, clip_epsilon
+    )
+    pg = _reduce(-surrogate, mask, config.reduction)
+    bl = baseline_loss(norm_targets - norm_values_new, mask, config.reduction)
+    ent = entropy_loss(learner_logits, mask, config.reduction)
+    n_valid = jnp.maximum(jnp.sum(mask), 1.0)
+    clipped = jnp.abs(ratio - 1.0) > clip_epsilon
+    out = assemble_loss(
+        pg=pg,
+        bl=bl,
+        ent=ent,
+        mask=mask,
+        config=config,
+        extra_logs={
+            "mean_vtrace_target": jnp.mean(vt.vs),
+            "mean_advantage": jnp.mean(vt.pg_advantages),
+            "impact_ratio": jnp.sum(ratio * mask) / n_valid,
+            "impact_clip_frac": jnp.sum(clipped * mask) / n_valid,
             "popart_mu_mean": jnp.mean(new_state.mu),
             "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
         },

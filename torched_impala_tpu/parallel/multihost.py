@@ -78,21 +78,41 @@ def local_batch_size(global_batch_size: int) -> int:
     return global_batch_size // n
 
 
-def place_batch(shardings: Any, arrays: Any) -> Any:
+def place_batch(shardings: Any, arrays: Any, *, on_shard=None) -> Any:
     """Host-local batch tree -> globally sharded device arrays.
 
-    Single-process this is exactly `jax.device_put(arrays, shardings)`;
-    multi-process, each host passes its `[T, B_local, ...]` slice and gets
-    back the global `[T, B_global, ...]` jax.Array view
-    (`jax.make_array_from_process_local_data` assembles it addressable-shard
-    -wise; no data leaves the host).
+    Single-process this shards each leaf with ONE `device_put` PER
+    DATA-PARALLEL SHARD, sliced straight from the host buffer (a
+    `traj_ring` slot view on the zero-copy path — no gather on a
+    staging device, no reshard hop), then assembles the global
+    `jax.Array` from the per-device pieces. Multi-process, each host
+    passes its `[T, B_local, ...]` slice and gets back the global
+    `[T, B_global, ...]` jax.Array view
+    (`jax.make_array_from_process_local_data` assembles it
+    addressable-shard-wise; no data leaves the host).
+
+    `on_shard(nbytes, t0_ns, t1_ns)`, when given, is invoked once per
+    completed per-device put (single-process path only) so the caller
+    can credit each shard's H2D interval to its overlap telemetry
+    (runtime/learner.py `_note_h2d`).
     """
     if process_count() == 1:
-        return jax.device_put(arrays, shardings)
+
+        def _apply(sh, subtree):
+            # `shardings` may be a prefix tree (one sharding covering a
+            # whole agent-state subtree), matching device_put's contract.
+            return jax.tree.map(
+                lambda x: _put_sharded(sh, x, on_shard), subtree
+            )
+
+        return jax.tree.map(
+            _apply,
+            shardings,
+            arrays,
+            is_leaf=lambda n: isinstance(n, jax.sharding.Sharding),
+        )
 
     def _apply(sh, subtree):
-        # `shardings` may be a prefix tree (one sharding covering a whole
-        # agent-state subtree), matching jax.device_put's contract.
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(sh, x), subtree
         )
@@ -102,4 +122,36 @@ def place_batch(shardings: Any, arrays: Any) -> Any:
         shardings,
         arrays,
         is_leaf=lambda n: isinstance(n, jax.sharding.Sharding),
+    )
+
+
+def _put_sharded(sharding, x, on_shard=None):
+    """One leaf -> global jax.Array via one device_put per shard.
+
+    Each shard is a numpy view (`x[idx]` with the slice tuple from the
+    sharding's index map) of the caller's buffer — for ring slots that
+    IS the slot memory, so nothing is staged host-side. Replicated
+    single-device shardings keep the plain put (identical dispatch, no
+    assembly overhead).
+    """
+    import time
+
+    shape = getattr(x, "shape", None)
+    if shape is None or not hasattr(sharding, "addressable_devices"):
+        return jax.device_put(x, sharding)
+    idx_map = sharding.addressable_devices_indices_map(tuple(shape))
+    if len(idx_map) <= 1:
+        return jax.device_put(x, sharding)
+    pieces = []
+    for dev, idx in idx_map.items():
+        t0 = time.monotonic_ns()
+        piece = jax.device_put(x[idx], dev)
+        if on_shard is not None:
+            # Block so the interval covers the transfer, not just its
+            # dispatch — the overlap fraction must stay honest.
+            piece.block_until_ready()
+            on_shard(piece.nbytes, t0, time.monotonic_ns())
+        pieces.append(piece)
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), sharding, pieces
     )

@@ -79,6 +79,47 @@ PARAM_PATTERNS = (
     ("*/embedding", "tp_column"),
 )
 
+# Feed-path batch placement (ISSUE 15): the learner's train step
+# consumes exactly these eight arrays, in this order. Each role maps to
+# (logical tensor name, batch-dim index) per layout, so BOTH the
+# runtime (feed_shardings / sharded place_batch below) and the static
+# checker (tools/lint/sharding.py feed-path rule) resolve every
+# feed-path device_put through the same table. "plain" is the
+# [T+1, B, ...] K=1 layout, "superbatch" the fused-dispatch
+# [K, T+1, B, ...] layout.
+BATCH_ROLES = (
+    "obs",
+    "first",
+    "actions",
+    "behaviour_logits",
+    "rewards",
+    "cont",
+    "task",
+    "agent_state",
+)
+BATCH_PLACEMENT = {
+    "plain": {
+        "obs": ("batch_time_major", 1),
+        "first": ("batch_time_major", 1),
+        "actions": ("batch_time_major", 1),
+        "behaviour_logits": ("batch_time_major", 1),
+        "rewards": ("batch_time_major", 1),
+        "cont": ("batch_time_major", 1),
+        "task": ("batch_major", 0),
+        "agent_state": ("batch_major", 0),
+    },
+    "superbatch": {
+        "obs": ("superbatch_time_major", 2),
+        "first": ("superbatch_time_major", 2),
+        "actions": ("superbatch_time_major", 2),
+        "behaviour_logits": ("superbatch_time_major", 2),
+        "rewards": ("superbatch_time_major", 2),
+        "cont": ("superbatch_time_major", 2),
+        "task": ("superbatch_major", 1),
+        "agent_state": ("superbatch_major", 1),
+    },
+}
+
 # --------------------------------------------------------------------------
 # Runtime builders over the tables (jax imported lazily so static
 # consumers of the literals never pay for it).
@@ -143,6 +184,44 @@ def with_leading(spec, n: int = 1):
 def tp_column_spec(rank: int):
     """Rank-`rank` Megatron column layout: last dim over 'model'."""
     return _pspec(*([None] * (rank - 1) + ["model"]))
+
+
+def feed_spec(role: str, *, superbatch: bool = False):
+    """The canonical PartitionSpec for one feed-path batch role."""
+    layout = "superbatch" if superbatch else "plain"
+    try:
+        logical, _ = BATCH_PLACEMENT[layout][role]
+    except KeyError:
+        raise KeyError(
+            f"unknown feed role {role!r}; SpecLayout declares "
+            f"{BATCH_ROLES}"
+        ) from None
+    return tensor_spec(logical)
+
+
+def feed_batch_dim(role: str, *, superbatch: bool = False) -> int:
+    """Which dimension of `role`'s array is the (data-sharded) batch."""
+    layout = "superbatch" if superbatch else "plain"
+    try:
+        return BATCH_PLACEMENT[layout][role][1]
+    except KeyError:
+        raise KeyError(
+            f"unknown feed role {role!r}; SpecLayout declares "
+            f"{BATCH_ROLES}"
+        ) from None
+
+
+def feed_shardings(mesh, *, superbatch: bool = False):
+    """NamedShardings for the eight feed-path arrays, in BATCH_ROLES
+    order — the ONLY sanctioned way for runtime code to build batch
+    shardings (the sharding checker's feed-path rule flags ad-hoc
+    NamedSharding construction in `runtime/`)."""
+    from jax.sharding import NamedSharding
+
+    return tuple(
+        NamedSharding(mesh, feed_spec(role, superbatch=superbatch))
+        for role in BATCH_ROLES
+    )
 
 
 def _require_declared(axis: str) -> None:

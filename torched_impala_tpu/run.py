@@ -64,7 +64,7 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "straight into preallocated learner batch slots "
                         "(no per-env Trajectory arrays, no np.stack); "
                         "needs vectorized actors whose env counts divide "
-                        "batch-size and the single-device K=1 learner "
+                        "batch-size; composes with --dp-devices meshes "
                         "(runtime/traj_ring.py)")
     p.add_argument("--max-reuse", type=int, default=None,
                    help="replay: deliver each committed unroll up to N "

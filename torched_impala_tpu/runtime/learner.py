@@ -140,9 +140,11 @@ class LearnerConfig:
     # learner batch slots and the batcher device_puts a completed slot
     # with NO host stacking — the shm-lanes -> Trajectory -> np.stack
     # copy chain collapses to one actor-side write. Opt-in (default
-    # off); single-device K=1 path only (the [K, ...] superbatch and
-    # mesh place_batch keep the queue path), and the actor fleet must be
-    # vectorized with env counts dividing batch_size (loop.py checks).
+    # off); the actor fleet must be vectorized with env counts dividing
+    # batch_size (loop.py checks). Under a mesh the slot is placed
+    # shard-by-shard straight from slot memory (one device_put per
+    # data-parallel shard via the SpecLayout batch-placement table —
+    # no gather/reshard hop; parallel/multihost.place_batch).
     # Recycling is free-list + generation counters; a slot returns only
     # after its H2D copy completes. On backends where device_put can
     # ALIAS host numpy (the stack_buffer_reuse probe), each batch is
@@ -178,8 +180,12 @@ class LearnerConfig:
     # target_update_interval steps. None — or a disabled ReplayConfig
     # (max_reuse=1, target_update_interval=0) — keeps the EXACT
     # pre-replay code path (bit-parity, tests/test_replay.py). Enabled
-    # replay requires traj_ring (the ring IS the replay buffer) and is
-    # single-device / no-PopArt / grad_accum=1 for now.
+    # replay requires traj_ring (the ring IS the replay buffer) and
+    # grad_accum=1 (no microbatch scan in the surrogate step); it
+    # composes with the mesh learner (the pinned target params ride the
+    # same shardings as the live ones) and with PopArt (the surrogate
+    # re-expresses normalized values under ops.popart.popart_impact_loss
+    # — f32 replicated stats, same as the on-policy path).
     replay: Optional[ReplayConfig] = None
 
 
@@ -572,8 +578,11 @@ class Learner:
         self._m_h2d_overlap_ns = reg.counter("perf/h2d_ns_overlapped")
         self._m_h2d_overlap_frac = reg.gauge("perf/h2d_overlap_frac")
         self._m_donated_batches = reg.counter("learner/donated_batches")
-        self._h2d_total_ns = 0
-        self._h2d_overlap_ns = 0
+        # Written only by the batcher thread (directly or via the
+        # place_batch per-shard callback); main thread only reads at
+        # snapshot time — int updates are atomic under the GIL.
+        self._h2d_total_ns = 0  # lint: guarded-by(gil)
+        self._h2d_overlap_ns = 0  # lint: guarded-by(gil)
         # Recent train-step compute intervals + the in-flight step's
         # start, read by the batcher thread to score each H2D dispatch
         # against compute. Benign cross-thread race: stale reads only
@@ -582,6 +591,13 @@ class Learner:
             maxlen=64
         )
         self._step_active_since_ns: Optional[int] = None  # lint: guarded-by(gil)
+        # Per-shard H2D accounting for the sharded place_batch path:
+        # place_batch invokes _on_shard_h2d once per per-device put, so
+        # the put's overlap credit comes from the shard intervals
+        # themselves, not the whole dispatch window (batcher thread
+        # only — reset by _put_batch before each placement).
+        self._put_shards = 0  # lint: guarded-by(gil)
+        self._put_overlap_ns = 0  # lint: guarded-by(gil)
         # Donated ring slots awaiting their consuming step's completion:
         # (slot, probe) pairs, released by _finish_step one step behind
         # so the release never stalls the pipeline.
@@ -615,12 +631,6 @@ class Learner:
                     "replay requires traj_ring=True: the trajectory ring "
                     "IS the circular replay buffer (docs/REPLAY.md)"
                 )
-            if config.popart is not None:
-                raise ValueError(
-                    "replay does not compose with PopArt yet (the "
-                    "clipped-target surrogate path has no per-task "
-                    "rescaling)"
-                )
             if config.grad_accum != 1:
                 raise ValueError(
                     "replay requires grad_accum=1 (the surrogate step "
@@ -641,11 +651,6 @@ class Learner:
         # adds two more so retained slots don't starve the free list.
         self.traj_ring: Optional[TrajectoryRing] = None
         if config.traj_ring:
-            if mesh is not None:
-                raise ValueError(
-                    "traj_ring supports the single-device learner only "
-                    "(mesh batches go through the sharded queue path)"
-                )
             if config.data_device is not None:
                 raise ValueError(
                     "traj_ring cannot combine with data_device (the "
@@ -730,11 +735,6 @@ class Learner:
         fused = config.steps_per_dispatch > 1
         step_impl = self._train_multi_impl if fused else self._train_step_impl
         if config.donate_batch:
-            if mesh is not None:
-                raise ValueError(
-                    "donate_batch supports the single-device learner "
-                    "only (the mesh path keeps non-donated batches)"
-                )
             if config.data_device is not None:
                 raise ValueError(
                     "donate_batch cannot combine with data_device (the "
@@ -766,29 +766,29 @@ class Learner:
         # compiles the standard step's formats, which the replay
         # program would then refuse.
         self._replay_step = None
-        if mesh is None:
-            # donate_batch extends donation past the state triple to the
-            # eight batch arguments (argnums 3..10): XLA may reuse the
-            # batch buffers as scratch, so the feed path never stages a
-            # defensive copy between ring slot and step (the zero-copy
-            # contract; the ring slot recycles only after the consuming
-            # step completes).
-            donate = (
-                tuple(range(11))
-                if config.donate_batch
-                else (0, 1, 2)
-            )
-            if config.donate_batch:
-                # Batch buffers rarely match an output shape, so XLA
-                # reports them "not usable" for output reuse on some
-                # backends — expected here (donation still frees XLA to
-                # scratch over them); don't warn once per compile.
-                import warnings
+        # donate_batch extends donation past the state triple to the
+        # eight batch arguments (argnums 3..10): XLA may reuse the
+        # batch buffers as scratch, so the feed path never stages a
+        # defensive copy between ring slot and step (the zero-copy
+        # contract; the ring slot recycles only after the consuming
+        # step completes). Identical under the mesh — pjit donates the
+        # per-shard buffers the batcher placed straight from slot
+        # memory.
+        donate = (
+            tuple(range(11)) if config.donate_batch else (0, 1, 2)
+        )
+        if config.donate_batch:
+            # Batch buffers rarely match an output shape, so XLA
+            # reports them "not usable" for output reuse on some
+            # backends — expected here (donation still frees XLA to
+            # scratch over them); don't warn once per compile.
+            import warnings
 
-                warnings.filterwarnings(
-                    "ignore",
-                    message="Some donated buffers were not usable",
-                )
+            warnings.filterwarnings(
+                "ignore",
+                message="Some donated buffers were not usable",
+            )
+        if mesh is None:
             self._train_step = jax.jit(step_impl, donate_argnums=donate)
             if self._replay is not None:
                 self._replay_step = jax.jit(
@@ -808,29 +808,21 @@ class Learner:
                         out_shardings=auto,
                     )
         else:
+            from torched_impala_tpu.parallel import spec_layout
+
             rep = replicated(mesh)
-            bs = batch_sharding(mesh)
-            ss = state_sharding(mesh)
-            if fused:
-                # Superbatch leaves carry a leading K axis the scan consumes;
-                # it stays unsharded (steps are sequential by construction).
-                from jax.sharding import NamedSharding
-
-                from torched_impala_tpu.parallel import spec_layout
-
-                def _k(sh):
-                    return NamedSharding(
-                        mesh, spec_layout.with_leading(sh.spec)
-                    )
-
-                bs, ss = _k(bs), _k(ss)
-            # Prefix pytrees: one sharding covers each whole subtree.
-            # (obs, first, actions, logits, rewards, cont all [T(+1), B, ...];
-            # tasks and agent_state leaves are [B, ...].)
-            self._batch_shardings = (bs, bs, bs, bs, bs, bs, ss, ss)
+            # The eight feed-path shardings come from the SpecLayout
+            # batch-placement table (plain [T+1, B, ...] or fused
+            # [K, T+1, B, ...] layouts; the K axis stays unsharded —
+            # steps are sequential by construction). Prefix pytrees:
+            # one sharding covers each whole subtree (tasks and
+            # agent_state leaves are [B, ...]).
+            self._batch_shardings = spec_layout.feed_shardings(
+                mesh, superbatch=fused
+            )
             self._train_step = jax.jit(
                 step_impl,
-                donate_argnums=(0, 1, 2),
+                donate_argnums=donate,
                 in_shardings=(
                     self._param_shardings,
                     self._opt_shardings,
@@ -844,6 +836,28 @@ class Learner:
                     rep,
                 ),
             )
+            if self._replay is not None:
+                # The pinned target params ride the live params'
+                # shardings (TargetParamStore's jnp.copy preserves
+                # them); replay pins K=1, so the batch shardings are
+                # the plain layout.
+                self._replay_step = jax.jit(
+                    self._train_step_replay_impl,
+                    donate_argnums=(0, 1, 2),
+                    in_shardings=(
+                        self._param_shardings,
+                        self._opt_shardings,
+                        rep,
+                        self._param_shardings,
+                    )
+                    + self._batch_shardings,
+                    out_shardings=(
+                        self._param_shardings,
+                        self._opt_shardings,
+                        rep,
+                        rep,
+                    ),
+                )
 
     # ---- the hot loop: one fused XLA program ---------------------------
 
@@ -1072,11 +1086,14 @@ class Learner:
         the clipped learner/target ratio; the grad-clip + optimizer tail
         is identical to `_train_step_impl`. `target_params` is NOT
         donated — the same pinned copy serves every step until the
-        TargetParamStore refreshes it. `popart_state` is threaded
-        untouched (replay validates PopArt off) so both step programs
-        share one output signature."""
+        TargetParamStore refreshes it. With PopArt on (ISSUE 15: the
+        lifted PopArt+replay carve-out) the step runs
+        `ops.popart.popart_impact_loss` and rescales the LIVE value head
+        across the stats move — the pinned target copy is a snapshot of
+        already-rescaled params, so it never needs in-step rescaling."""
         cfg = self._config.loss
         rp = self._config.replay
+        pa_cfg = self._config.popart
         target_out, _ = self._agent.unroll(
             target_params, obs, first, agent_state
         )
@@ -1085,25 +1102,47 @@ class Learner:
         )
 
         def loss_fn(p):
-            net_out, _ = self._agent.unroll(p, obs, first, agent_state)
-            values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
-            out = impact_loss(
-                learner_logits=net_out.policy_logits[:-1],
+            if pa_cfg is None:
+                net_out, _ = self._agent.unroll(
+                    p, obs, first, agent_state
+                )
+                values = jnp.squeeze(net_out.values, -1)  # [T+1, B]
+                out = impact_loss(
+                    learner_logits=net_out.policy_logits[:-1],
+                    target_logits=target_logits,
+                    behaviour_logits=behaviour_logits,
+                    values=values[:-1],
+                    bootstrap_value=values[-1],
+                    actions=actions,
+                    rewards=rewards,
+                    discounts=cfg.discount * cont,
+                    clip_epsilon=rp.target_clip_epsilon,
+                    config=cfg,
+                )
+                return out.total, (out.logs, popart_state)
+            policy_logits, norm_values = self._popart_forward(
+                p, obs, first, agent_state, tasks
+            )
+            out, new_pa = popart_ops.popart_impact_loss(
+                learner_logits=policy_logits[:-1],
                 target_logits=target_logits,
                 behaviour_logits=behaviour_logits,
-                values=values[:-1],
-                bootstrap_value=values[-1],
+                norm_values=norm_values[:-1],
+                norm_bootstrap=norm_values[-1],
                 actions=actions,
                 rewards=rewards,
                 discounts=cfg.discount * cont,
+                tasks=tasks,
+                state=popart_state,
+                popart_config=pa_cfg,
                 clip_epsilon=rp.target_clip_epsilon,
                 config=cfg,
             )
-            return out.total, out.logs
+            return out.total, (out.logs, new_pa)
 
-        (_, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params
-        )
+        (_, (logs, new_popart)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
         grad_norm = optax.global_norm(grads)
         if self._config.max_grad_norm is not None:
             scale = jnp.minimum(
@@ -1114,10 +1153,14 @@ class Learner:
             grads, opt_state, params
         )
         params = optax.apply_updates(params, updates)
+        if pa_cfg is not None:
+            params = popart_ops.rescale_params(
+                params, popart_state, new_popart, pa_cfg
+            )
         logs = dict(logs)
         logs["grad_norm_unclipped"] = grad_norm
         logs["weight_norm"] = optax.global_norm(params)
-        return params, opt_state, popart_state, logs
+        return params, opt_state, new_popart, logs
 
     def _train_multi_impl(
         self, params, opt_state, popart_state, *stacked
@@ -1505,9 +1548,24 @@ class Learner:
             if fmts is not None:
                 return jax.tree.map(_put_format, arrays, fmts)
             return jax.device_put(arrays)
-        # Single-host: sharded device_put. Multi-host: this host's
-        # local slice becomes its shards of the global batch array.
-        return multihost.place_batch(self._batch_shardings, arrays)
+        # Single-host: one device_put PER DATA SHARD, sliced straight
+        # from the host buffer (a ring slot view on the zero-copy path)
+        # and credited shard-by-shard to the h2d overlap telemetry.
+        # Multi-host: this host's local slice becomes its shards of the
+        # global batch array.
+        self._put_shards = 0
+        self._put_overlap_ns = 0
+        return multihost.place_batch(
+            self._batch_shardings, arrays, on_shard=self._on_shard_h2d
+        )
+
+    def _on_shard_h2d(self, nbytes: int, t0_ns: int, t1_ns: int) -> None:
+        """place_batch per-shard completion callback (batcher thread):
+        credit each shard's own transfer interval so
+        perf/h2d_overlap_frac stays honest under the mesh (the whole
+        dispatch window would over-count idle gaps between shards)."""
+        self._put_shards += 1
+        self._put_overlap_ns += self._note_h2d(t0_ns, t1_ns)
 
     def _note_h2d(self, t0_ns: int, t1_ns: int) -> int:
         """Score one H2D dispatch interval against the learner's recent
@@ -1593,7 +1651,11 @@ class Learner:
             on_device = self._put_batch(arrays)
             put_span.__exit__()
             put_dur = time.monotonic_ns() - put_t0
-            self._note_h2d(put_t0, put_t0 + put_dur)
+            if self._put_shards == 0:
+                # Sharded placement already credited each per-device
+                # put interval via _on_shard_h2d; only the unsharded
+                # paths score the whole dispatch window.
+                self._note_h2d(put_t0, put_t0 + put_dur)
             self._tracer.complete(
                 "learner/device_put",
                 put_t0,
@@ -1671,7 +1733,12 @@ class Learner:
             on_device = self._put_batch(arrays)
             put_span.__exit__()
             put_dur = time.monotonic_ns() - put_t0
-            overlap_ns = self._note_h2d(put_t0, put_t0 + put_dur)
+            if self._put_shards:
+                # Sharded placement: per-device put intervals were
+                # credited shard-by-shard via _on_shard_h2d.
+                overlap_ns = self._put_overlap_ns
+            else:
+                overlap_ns = self._note_h2d(put_t0, put_t0 + put_dur)
             if donate:
                 # Distinct span name for the overlapped path: report.py
                 # scores learner/h2d* against compute intervals and must

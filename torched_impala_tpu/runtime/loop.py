@@ -132,9 +132,11 @@ def train(
     `learner_config.traj_ring=True` switches the actor->learner edge to
     the zero-copy trajectory ring (runtime/traj_ring.py): actors write
     unrolls straight into shared `[T+1, B, ...]` batch slots, the
-    batcher device_puts completed slots with no host stacking. Needs a
-    vectorized actor fleet whose env counts divide batch_size (checked
-    at startup) and the single-device K=1 learner path.
+    batcher device_puts completed slots with no host stacking — under a
+    mesh, one device_put per data-parallel shard sliced straight from
+    the slot (parallel/multihost.place_batch; no gather/reshard hop).
+    Needs a vectorized actor fleet whose env counts divide batch_size
+    (checked at startup).
 
     Observability (docs/OBSERVABILITY.md):
     - `telemetry_interval=N` merges the global telemetry registry's
@@ -521,6 +523,11 @@ def train(
             batch_size=learner_config.batch_size,
             steps_per_dispatch=getattr(
                 learner_config, "steps_per_dispatch", 1
+            ),
+            # Per-shard-aware B grid: proposals stay divisible by the
+            # mesh's data axis (1 when unmeshed — grid unchanged).
+            data_shards=(
+                dict(mesh.shape).get("data", 1) if mesh is not None else 1
             ),
             interval_s=control.interval_s,
             tolerance=control.tolerance,

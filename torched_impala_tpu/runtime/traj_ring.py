@@ -57,6 +57,17 @@ axis the learner's fused multi-step dispatch consumes directly — one
 H2D transfer and one dispatch for K SGD steps, no host re-stacking.
 With ``superbatch_k == 1`` buffer shapes and delivery are exactly
 today's (no leading axis) — the disabled-flag parity contract.
+
+Mesh learners (ISSUE 15): a delivered slot feeds the data-parallel
+mesh with ONE ``device_put`` PER SHARD — ``place_batch``
+(parallel/multihost.py) slices each slot array along the
+BATCH_PLACEMENT batch dim into per-device numpy views of the slot
+memory and assembles the global ``jax.Array``; no gather on a staging
+device, no reshard hop. Slot recycling semantics are unchanged:
+``release_after_transfer`` blocks on the ASSEMBLED global array, which
+by construction covers every shard's H2D completion, and under
+``donate_batch`` the slot is released one step behind exactly as on a
+single device.
 """
 
 from __future__ import annotations
