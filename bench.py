@@ -300,6 +300,18 @@ def main(args) -> None:
     _promote_fused(result)
     section("learner_deep_breakout", lambda: run_bench_deep(jax), gate=tpu_ok)
     section("learner_scaling", lambda: run_bench_scaling(jax), gate=tpu_ok)
+    # Compute-side MFU (ISSUE 16): bf16-vs-f32 step ratio + fused LSTM
+    # ratio; ratios are same-backend quotients but budget-gated on TPU
+    # only (bench runs tiny-prefixed on the CPU fallback). mfu_b1024
+    # reuses the B=1024 headline MFU rather than recompiling it.
+    section(
+        "compute",
+        lambda: run_bench_compute(
+            jax,
+            tiny=not tpu_ok,
+            headline_mfu=result.get("mfu_estimate") if tpu_ok else None,
+        ),
+    )
     section("learner_remat", lambda: run_bench_remat(jax), gate=tpu_ok)
     section(
         "vtrace_pallas_vs_scan",
@@ -395,7 +407,7 @@ def main(args) -> None:
         }
     # Stays partial if the alarm skipped anything OR the headline errored:
     # tunnel_watch.sh promotes only `"partial": false` runs to
-    # BENCH_live.json and stops watching, so a capture missing its
+    # docs/evidence/BENCH_live.json and stops watching, so a capture missing its
     # load-bearing number must never qualify. (Per-SECTION errors don't
     # block promotion — section isolation is by design, e.g. an OOM arm
     # of the remat quadrant.)
@@ -449,6 +461,7 @@ class _LearnerFixture:
         fused_k=1,
         grad_accum=1,
         num_tasks=1,
+        train_dtype="float32",
     ):
         import jax.numpy as jnp
         import numpy as np
@@ -480,6 +493,7 @@ class _LearnerFixture:
                 publish_interval=1_000_000,
                 steps_per_dispatch=fused_k,
                 grad_accum=grad_accum,
+                train_dtype=train_dtype,
                 popart=(
                     PopArtConfig(num_values=num_tasks)
                     if num_tasks > 1
@@ -671,9 +685,12 @@ def run_bench(jax, tpu_ok: bool) -> dict:
 
     from torched_impala_tpu.models import AtariShallowTorso
 
-    # Full Pong shapes on TPU; a reduced batch on the CPU fallback so the
-    # run finishes in minutes (the number is labeled non-comparable anyway).
-    T, B = (20, 256) if tpu_ok else (20, 32)
+    # Large-batch default operating point on TPU (ISSUE 16): B=1024 is
+    # the headline row — the MXU runs closest to peak there and the
+    # linear lr-scaling + warmup schedule (configs.make_lr_schedule)
+    # keeps training equivalent. A reduced batch on the CPU fallback so
+    # the run finishes in minutes (labeled non-comparable anyway).
+    T, B = (20, 1024) if tpu_ok else (20, 32)
     log(f"bench: backend={jax.default_backend()} T={T} B={B}")
     # bf16 torso matches the pong preset (configs.py): conv FLOPs on the
     # MXU fast path, heads/loss in f32.
@@ -736,7 +753,7 @@ def run_bench(jax, tpu_ok: bool) -> dict:
             "for the whole of round 3 — tunnel_watch.log records 10+ "
             "hours of failed bounded probes); CPU fallback number — not "
             "comparable to the 62.5k/chip TPU yardstick. Latest real-chip "
-            "evidence is committed in BENCH_live.json (502k learner "
+            "evidence is committed in docs/evidence/BENCH_live.json (502k learner "
             "frames/s/chip, vs_baseline 8.04, captured 2026-07-29) with "
             "the profiler trace under traces/bench/; tunnel_watch.sh + "
             "tools/tunnel_watch_respawn.sh auto-capture and commit a "
@@ -1034,6 +1051,120 @@ def run_bench_scaling(jax) -> dict:
     return out
 
 
+def run_bench_compute(jax, tiny: bool = False, headline_mfu=None) -> dict:
+    """Compute-side MFU section (ISSUE 16): same-backend step-time
+    ratios for the two new compute paths, plus the B=1024 headline MFU.
+
+    - train_dtype_step_ratio: full-bf16 train step / f32 train step
+      (LearnerConfig.train_dtype; params+activations bf16 inside the
+      loss, f32 optimizer/PopArt/V-trace accumulators). Budgeted < 1.0
+      on TPU only — CPU bf16 is software-emulated and reads slower.
+    - lstm_fused_step_ratio: fused Pallas LSTM cell unroll
+      (models/lstm.py) / flax OptimizedLSTMCell unroll, fwd+bwd.
+      Interpret mode off-TPU, so the tiny row only proves the path runs.
+    - mfu_b1024: the headline fixture's MFU estimate at the B=1024
+      default operating point (TPU runs only; passed in from the
+      headline section rather than recompiling the same program).
+    """
+    import time as _time
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torched_impala_tpu.models import AtariShallowTorso
+    from torched_impala_tpu.models.lstm import PallasLSTMCell
+
+    T, B = (5, 8) if tiny else (20, 256)
+    steps = 3 if tiny else 15
+    out = {}
+
+    # -- full-bf16 step vs f32 step (identical program shape) ----------
+    times = {}
+    for train_dtype in ("float32", "bfloat16"):
+        fx = _LearnerFixture(
+            jax,
+            torso=AtariShallowTorso(dtype=jnp.bfloat16),
+            num_actions=6,
+            T=T,
+            B=B,
+            train_dtype=train_dtype,
+        )
+        fx.run_steps(1 if tiny else 6)
+        _, dt = fx.timed_frames_per_sec(steps)
+        times[train_dtype] = dt / steps
+        out[f"{train_dtype}_step_ms"] = round(1e3 * dt / steps, 3)
+    out["train_dtype_step_ratio"] = round(
+        times["bfloat16"] / times["float32"], 4
+    )
+
+    # -- fused vs flax LSTM cell unroll (fwd+bwd through a scan) -------
+    H = 32 if tiny else 256
+    Tl, Bl = (4, 8) if tiny else (20, 64)
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.normal(size=(Tl, Bl, H)), jnp.float32)
+    carry0 = (jnp.zeros((Bl, H), jnp.float32),) * 2
+
+    def _unroll_loss(cell_cls):
+        class _Unroll(nn.Module):
+            @nn.compact
+            def __call__(self, xs):
+                scan = nn.scan(
+                    lambda cell, carry, x: cell(carry, x),
+                    variable_broadcast="params",
+                    split_rngs={"params": False},
+                    in_axes=0,
+                    out_axes=0,
+                )
+                _, ys = scan(cell_cls(H, name="lstm"), carry0, xs)
+                return jnp.sum(ys)
+
+        mod = _Unroll()
+        params = mod.init(jax.random.key(0), xs)
+        step = jax.jit(jax.value_and_grad(lambda p: mod.apply(p, xs)))
+        jax.block_until_ready(step(params))  # compile + warmup
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            loss, grads = step(params)
+        jax.block_until_ready(grads)
+        return (_time.perf_counter() - t0) / steps
+
+    flax_t = _unroll_loss(nn.OptimizedLSTMCell)
+    fused_t = _unroll_loss(PallasLSTMCell)
+    out["lstm_flax_unroll_ms"] = round(1e3 * flax_t, 3)
+    out["lstm_fused_unroll_ms"] = round(1e3 * fused_t, 3)
+    out["lstm_fused_step_ratio"] = round(fused_t / flax_t, 4)
+
+    if headline_mfu is not None:
+        out["mfu_b1024"] = headline_mfu
+
+    backend = jax.default_backend()
+    _history_append(
+        "compute",
+        {
+            k: out[k]
+            for k in ("train_dtype_step_ratio", "lstm_fused_step_ratio")
+        },
+        tiny=tiny,
+        direction="lower",
+        backend=backend,
+    )
+    if headline_mfu is not None:
+        _history_append(
+            "compute",
+            {"mfu_b1024": headline_mfu},
+            tiny=tiny,
+            direction="higher",
+            backend=backend,
+        )
+    log(
+        f"bench: compute train_dtype_ratio="
+        f"{out['train_dtype_step_ratio']} lstm_fused_ratio="
+        f"{out['lstm_fused_step_ratio']} mfu_b1024={headline_mfu}"
+    )
+    return out
+
+
 def run_bench_anakin(jax, tpu_ok: bool) -> dict:
     """Fully on-device actor-learner throughput (runtime/anakin.py): pure-JAX
     CartPole envs + MLP policy + V-trace update fused into one XLA program.
@@ -1090,7 +1221,7 @@ def run_bench_anakin(jax, tpu_ok: bool) -> dict:
 
 
 # Locked most-promising (E, T, N) configs for the fast capture mode.
-# Re-tuned from the r4 steady-state full-sweep re-run (BENCH_live.json
+# Re-tuned from the r4 steady-state full-sweep re-run (docs/evidence/BENCH_live.json
 # anakin_pixels, warmup-window protocol): N=1 beat N=8 at every (E, T)
 # on the current low-dispatch-latency tunnel, and with first-window
 # noise removed the program is compute-bound by E=128 — E128_T20 led at

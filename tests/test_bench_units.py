@@ -477,3 +477,119 @@ def test_loadgen_budgets_pinned_in_perfgate():
     assert len(findings) == 2, findings
     assert any("fleet_goodput_ratio" in f for f in findings)
     assert any("serving_p99_ms" in f for f in findings)
+
+
+def test_compute_bench_tiny_runs_both_paths(jax_cpu, tmp_path, monkeypatch):
+    """The ISSUE 16 compute section's tiny CI variant: the full-bf16
+    train step and the fused Pallas LSTM unroll both run end-to-end on
+    CPU (interpret mode; software bf16) and produce finite ratios. No
+    speed assertion here — the <1.0 budgets are TPU-scoped in perfgate,
+    CPU emulation legitimately reads slower."""
+    from bench import run_bench_compute
+
+    hist = str(tmp_path / "BENCH_HISTORY.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY_PATH", hist)
+    out = run_bench_compute(jax_cpu, tiny=True)
+    import math
+
+    for key in ("train_dtype_step_ratio", "lstm_fused_step_ratio"):
+        assert key in out, out
+        assert math.isfinite(out[key]) and out[key] > 0, out
+    # No TPU in CI, so the headline MFU row must be absent, and the
+    # appended history rows carry the tiny_ prefix (never budget-gated).
+    assert "mfu_b1024" not in out, out
+    import json
+
+    with open(hist) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    metrics = {r["metric"] for r in rows}
+    assert "tiny_train_dtype_step_ratio" in metrics, metrics
+    assert "tiny_lstm_fused_step_ratio" in metrics, metrics
+
+
+def test_compute_budgets_pinned_in_perfgate():
+    """The compute floors are load-bearing but TPU-scoped: bf16 must
+    beat f32 by >= 5% and the fused LSTM must be no slower than flax on
+    real MXUs, while CPU records (software bf16, interpret-mode Pallas)
+    pass vacuously. mfu_b1024 pins the B=1024 default operating point."""
+    from tools.perfgate import BUDGETS, check_records
+
+    assert BUDGETS["train_dtype_step_ratio"] == {
+        "max": 0.95,
+        "fingerprint_contains": "tpu",
+    }
+    assert BUDGETS["lstm_fused_step_ratio"] == {
+        "max": 1.0,
+        "fingerprint_contains": "tpu",
+    }
+    assert BUDGETS["mfu_b1024"] == {
+        "min": 0.15,
+        "fingerprint_contains": "tpu",
+    }
+
+    def rec(metric, value, direction, fingerprint):
+        return {
+            "metric": metric,
+            "value": value,
+            "direction": direction,
+            "fingerprint": fingerprint,
+            "sha": "deadbeef",
+        }
+
+    tpu = "somebox|x86_64|tpu-v5e-8"
+    cpu = "somebox|x86_64|cpu1"
+    good = [
+        rec("train_dtype_step_ratio", 0.62, "lower", tpu),
+        rec("lstm_fused_step_ratio", 0.9, "lower", tpu),
+        rec("mfu_b1024", 0.31, "higher", tpu),
+        # CPU rows violating the TPU floors are out of scope: pass.
+        rec("train_dtype_step_ratio", 1.4, "lower", cpu),
+        rec("lstm_fused_step_ratio", 1.2, "lower", cpu),
+    ]
+    assert check_records(good) == []
+    bad = [
+        rec("train_dtype_step_ratio", 1.02, "lower", tpu),
+        rec("lstm_fused_step_ratio", 1.3, "lower", tpu),
+        rec("mfu_b1024", 0.04, "higher", tpu),
+    ]
+    findings = check_records(bad)
+    assert len(findings) == 3, findings
+    assert any("train_dtype_step_ratio" in f for f in findings)
+    assert any("lstm_fused_step_ratio" in f for f in findings)
+    assert any("mfu_b1024" in f for f in findings)
+
+
+def test_no_drop_check_budget_flag():
+    """`no_drop_check` budgets skip the trailing-median comparison (the
+    tiny mesh placement ratio divides two sub-ms host puts — pure
+    dispatch noise) while their absolute ceiling still gates, and the
+    flag never leaks onto metrics that don't set it."""
+    from tools.perfgate import BUDGETS, check_records
+
+    assert BUDGETS["tiny_mesh_feed_step_ratio"] == {
+        "max": 2.0,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    }
+
+    def rec(metric, value):
+        return {
+            "metric": metric,
+            "value": value,
+            "direction": "lower",
+            "fingerprint": "somebox|x86_64|cpu1",
+            "sha": "deadbeef",
+        }
+
+    # 4 priors at ~0.6, newest 1.1: an 80%+ median excursion that the
+    # drop check would flag — exempted, and under the 2.0 ceiling.
+    noisy = [rec("tiny_mesh_feed_step_ratio", v) for v in
+             (0.55, 0.62, 0.6, 0.69, 1.1)]
+    assert check_records(noisy) == []
+    # The absolute ceiling still fires.
+    findings = check_records(noisy + [rec("tiny_mesh_feed_step_ratio", 2.3)])
+    assert len(findings) == 1 and "2.3" in findings[0], findings
+    # A metric without the flag keeps the normal drop check.
+    plain = [rec("tiny_other_ratio", v) for v in (0.6, 0.6, 0.6, 0.6, 1.1)]
+    findings = check_records(plain)
+    assert len(findings) == 1 and "trailing median" in findings[0], findings

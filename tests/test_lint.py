@@ -548,6 +548,66 @@ class TestDtypeChecker:
             dtypes.check([sf3])
         )
 
+    def test_policy_table_rogue_accumulator_fires(self):
+        """A bf16 accumulator role in MIXED_PRECISION_POLICY (the
+        declarative table the allow-list is derived from, ISSUE 16)
+        fires dtype/policy-accumulator-not-f32 — and a rogue table
+        that drops half_bindings stops exempting vtrace_pallas."""
+        rel = "torched_impala_tpu/ops/precision.py"
+        rogue = (
+            "MIXED_PRECISION_POLICY = {\n"
+            '    "accumulators": {\n'
+            '        "optimizer_state": "float32",\n'
+            '        "popart_stats": "bfloat16",\n'
+            "    },\n"
+            '    "compute": {"torso": ("float32",)},\n'
+            '    "half_bindings": (),\n'
+            "}\n"
+        )
+        sf = SourceFile(f"<{rel}>", rel, rogue)
+        found = dtypes.check([sf])
+        assert "dtype/policy-accumulator-not-f32" in rules_of(found)
+        bad = [
+            f
+            for f in found
+            if f.rule == "dtype/policy-accumulator-not-f32"
+        ]
+        assert len(bad) == 1 and "popart_stats" in bad[0].message
+        assert bad[0].line == 4  # the rogue value's own line
+        # With half_bindings emptied, the previously sanctioned
+        # vtrace_pallas binding is no longer exempt.
+        vt_rel = "torched_impala_tpu/ops/vtrace_pallas.py"
+        vt = SourceFile(
+            f"<{vt_rel}>",
+            vt_rel,
+            '_FUSED_COMPUTE_DTYPES = ("float32", "bfloat16")\n',
+        )
+        assert "dtype/half-in-accumulator-module" in rules_of(
+            dtypes.check([sf, vt])
+        )
+
+    def test_policy_table_on_disk_is_clean_and_parseable(self):
+        """The committed table literal_evals and declares every
+        accumulator role float32 (the property rule 4 polices)."""
+        import ast as ast_mod
+        import os
+
+        from tools.lint.core import REPO
+
+        path = os.path.join(
+            REPO, "torched_impala_tpu", "ops", "precision.py"
+        )
+        with open(path, encoding="utf-8") as f:
+            tree = ast_mod.parse(f.read())
+        assign = dtypes._policy_assign(tree)
+        assert assign is not None
+        table = ast_mod.literal_eval(assign.value)
+        assert set(table["accumulators"].values()) == {"float32"}
+        assert (
+            "torched_impala_tpu/ops/vtrace_pallas.py",
+            "_FUSED_COMPUTE_DTYPES",
+        ) in set(map(tuple, table["half_bindings"]))
+
 
 # ---- transitive hot-loop analysis (ISSUE 11 satellite) -------------------
 

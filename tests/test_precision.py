@@ -1,0 +1,215 @@
+"""Mixed-precision policy tests (ISSUE 16): the declarative policy
+table in ops/precision.py, the full-bf16 train step's f32 accumulator
+contract through a REAL learner step, bf16/f32 loss-grad tolerance
+parity, the greedy-action parity gate, and the half-accumulator refusal
+path at the checkpoint-restore boundary."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torched_impala_tpu import configs
+from torched_impala_tpu.envs import ScriptedEnv
+from torched_impala_tpu.models import Agent, ImpalaNet, MLPTorso
+from torched_impala_tpu.ops import ImpalaLossConfig, precision
+from torched_impala_tpu.runtime import Actor, Learner, LearnerConfig
+
+
+def _agent(num_actions=2):
+    return Agent(
+        ImpalaNet(num_actions=num_actions, torso=MLPTorso(hidden_sizes=(16,)))
+    )
+
+
+def _learner(train_dtype, T=5, B=3):
+    return Learner(
+        agent=_agent(),
+        optimizer=optax.rmsprop(1e-3, decay=0.99, eps=1e-7),
+        config=LearnerConfig(
+            batch_size=B,
+            unroll_length=T,
+            loss=ImpalaLossConfig(),
+            train_dtype=train_dtype,
+        ),
+        example_obs=np.zeros((4,), np.float32),
+        rng=jax.random.key(0),
+    )
+
+
+def _synthetic_batch(T=5, B=3, num_actions=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(
+        obs=jnp.asarray(rng.normal(size=(T + 1, B, 4)), jnp.float32),
+        first=jnp.asarray(rng.uniform(size=(T + 1, B)) < 0.1),
+        actions=jnp.asarray(
+            rng.integers(0, num_actions, size=(T, B)), jnp.int32
+        ),
+        behaviour_logits=jnp.asarray(
+            rng.normal(size=(T, B, num_actions)), jnp.float32
+        ),
+        rewards=jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+        cont=jnp.asarray((rng.uniform(size=(T, B)) > 0.05), jnp.float32),
+        tasks=jnp.zeros((B,), jnp.int32),
+        agent_state=(),
+    )
+
+
+class TestPolicyTable:
+    def test_accumulator_roles_all_f32(self):
+        roles = precision.accumulator_roles()
+        assert "optimizer_state" in roles
+        assert "popart_stats" in roles
+        assert "vtrace_recursion" in roles
+        for role in roles:
+            assert (
+                precision.MIXED_PRECISION_POLICY["accumulators"][role]
+                == "float32"
+            )
+
+    def test_compute_roles_and_validation(self):
+        assert "bfloat16" in precision.compute_dtypes("train_step")
+        precision.validate_compute_dtype("train_step", "bfloat16")
+        with pytest.raises(ValueError, match="train_step"):
+            precision.validate_compute_dtype("train_step", "float16")
+        with pytest.raises(ValueError, match="unknown"):
+            precision.validate_compute_dtype("nonexistent_role", "float32")
+
+    def test_cast_to_compute_floating_only(self):
+        tree = {
+            "w": jnp.ones((2, 2), jnp.float32),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        out = precision.cast_to_compute(tree, "bfloat16")
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["step"].dtype == jnp.int32
+
+    def test_half_leaves_reports_paths(self):
+        tree = {"a": jnp.ones((2,), jnp.bfloat16), "b": jnp.ones((2,))}
+        found = precision.half_leaves(tree)
+        assert len(found) == 1
+        (path, name), = found.items()
+        assert "a" in path and name == "bfloat16"
+
+    def test_assert_f32_accumulators_raises_with_role_and_path(self):
+        good = {"optimizer_state": {"mu": jnp.zeros((3,), jnp.float32)}}
+        precision.assert_f32_accumulators(good, context="test")
+        bad = {"popart_stats": {"mu": jnp.zeros((3,), jnp.bfloat16)}}
+        with pytest.raises(ValueError) as e:
+            precision.assert_f32_accumulators(bad, context="test")
+        assert "popart_stats" in str(e.value)
+        assert "bfloat16" in str(e.value)
+
+
+class TestFullBf16Step:
+    def test_grad_parity_bf16_vs_f32(self):
+        """The bf16 loss-grad agrees with f32 within bf16 rounding: same
+        params, same batch, gradients returned in f32 either way (the
+        convert_element_type transpose), close in direction and scale."""
+        lr_f32 = _learner("float32")
+        lr_bf16 = _learner("bfloat16")
+        batch = _synthetic_batch()
+        g32, logs32, _ = lr_f32._compute_grads(
+            lr_f32._params, (), **batch
+        )
+        g16, logs16, _ = lr_bf16._compute_grads(
+            lr_bf16._params, (), **batch
+        )
+        # Grads come back f32 regardless of the compute dtype.
+        for leaf in jax.tree.leaves(g16):
+            assert leaf.dtype == jnp.float32
+        # Tolerance parity: bf16 has ~8 mantissa bits, so per-leaf
+        # agreement is coarse but the gradient as a whole must point
+        # the same way at the same magnitude.
+        v32 = jnp.concatenate(
+            [leaf.ravel() for leaf in jax.tree.leaves(g32)]
+        )
+        v16 = jnp.concatenate(
+            [leaf.ravel() for leaf in jax.tree.leaves(g16)]
+        )
+        cos = float(
+            jnp.vdot(v32, v16)
+            / (jnp.linalg.norm(v32) * jnp.linalg.norm(v16))
+        )
+        assert cos > 0.98, cos
+        norm_ratio = float(jnp.linalg.norm(v16) / jnp.linalg.norm(v32))
+        assert 0.9 < norm_ratio < 1.1, norm_ratio
+        loss_rel = abs(
+            float(logs16["total_loss"]) - float(logs32["total_loss"])
+        ) / max(1e-6, abs(float(logs32["total_loss"])))
+        assert loss_rel < 0.05, loss_rel
+
+    def test_accumulators_stay_f32_through_full_step(self):
+        """One real actor-fed SGD step under train_dtype=bfloat16: the
+        published params, every optimizer-state leaf, and the loss are
+        exactly float32 / finite afterwards — the bf16 cast lives only
+        inside the differentiated closure."""
+        T, B = 5, 2
+        learner = _learner("bfloat16", T=T, B=B)
+        actor = Actor(
+            actor_id=0,
+            env=ScriptedEnv(episode_len=4),
+            agent=learner._agent,
+            param_store=learner.param_store,
+            enqueue=learner.enqueue,
+            unroll_length=T,
+            seed=0,
+        )
+        for _ in range(B):
+            actor.unroll_and_push()
+        learner.start()
+        logs = learner.step_once(timeout=60)
+        learner.stop()
+        assert np.isfinite(float(logs["total_loss"]))
+        for leaf in jax.tree.leaves(learner._params):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+        for leaf in jax.tree.leaves(learner._opt_state):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                assert leaf.dtype == jnp.float32, leaf.dtype
+
+    def test_learner_rejects_unknown_train_dtype(self):
+        with pytest.raises(ValueError):
+            _learner("float16")
+
+    def test_set_state_refuses_bf16_optimizer_moments(self):
+        """Restore-boundary refusal: a checkpoint whose optimizer
+        moments were saved in bf16 must be rejected before it replaces
+        the live f32 state (silent-corruption guard; the doctor
+        'mixed precision' row probes the PopArt flavor)."""
+        learner = _learner("float32")
+        state = learner.get_state()
+        state["opt_state"] = jax.tree.map(
+            lambda a: (
+                a.astype(np.float32).astype(jnp.bfloat16)
+                if np.issubdtype(np.asarray(a).dtype, np.floating)
+                else a
+            ),
+            state["opt_state"],
+        )
+        with pytest.raises(ValueError, match="optimizer_state"):
+            learner.set_state(state)
+
+
+class TestParityGate:
+    def test_cartpole_bf16_passes(self):
+        cfg = dataclasses.replace(
+            configs.REGISTRY["cartpole"], train_dtype="bfloat16"
+        )
+        ok, mismatches = configs.check_train_dtype_parity(
+            cfg, seed=0, batch=8, unroll=4
+        )
+        assert ok and mismatches == 0
+
+    def test_float32_short_circuits(self):
+        cfg = configs.REGISTRY["cartpole"]
+        assert configs.check_train_dtype_parity(cfg) == (True, 0)
+
+    def test_make_agent_validates_train_dtype(self):
+        cfg = dataclasses.replace(
+            configs.REGISTRY["cartpole"], train_dtype="float16"
+        )
+        with pytest.raises(ValueError):
+            configs.make_agent(cfg)

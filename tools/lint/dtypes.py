@@ -31,16 +31,23 @@ Rules:
   parity gate can see them; host-side casts hide the boundary (and buy
   nothing — the host copy is f32-sized anyway). Deliberate host casts
   (e.g. the serving cache) carry an inline ``allow``.
+- ``dtype/policy-accumulator-not-f32`` — the declarative policy table
+  itself (``ops/precision.py:MIXED_PRECISION_POLICY``) declares an
+  accumulator role in anything other than float32. The table is the
+  single source of truth (ISSUE 16): this checker derives its
+  half-binding allow-list from it, so a rogue edit there would
+  otherwise silently relax the accumulator rules repo-wide.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 import re
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.lint import ipa
-from tools.lint.core import Finding, SourceFile
+from tools.lint.core import REPO, Finding, SourceFile
 from tools.lint.jitb import _collect_scope, _traced_functions
 
 RULES = {
@@ -56,20 +63,24 @@ RULES = {
         "half-precision cast outside any jit root (the bf16 boundary "
         "belongs inside the compiled program)"
     ),
+    "dtype/policy-accumulator-not-f32": (
+        "mixed-precision policy table declares an accumulator role in "
+        "half precision (ops/precision.py accumulators are f32-only)"
+    ),
 }
 
 _HALF_NAMES = {"bfloat16", "float16", "half"}
 _ACCUM_MODULE = re.compile(r"(popart|vtrace)", re.IGNORECASE)
-# The ONE sanctioned half-precision entry point inside an accumulator
-# module (ISSUE 13): the fused V-trace+loss epilogue's compute-dtype
-# allow-list constant. Only its [T, B, A] softmax/elementwise phase may
-# run at bf16 — the recursion and every reduction stay f32, policed at
-# runtime by the parity gate in tests/test_feed_path.py. Any OTHER half
-# token in popart/vtrace modules still fires; extend this set only with
-# a matching runtime gate.
-_ALLOWED_HALF_BINDINGS = {
-    ("torched_impala_tpu/ops/vtrace_pallas.py", "_FUSED_COMPUTE_DTYPES"),
-}
+# The sanctioned half-precision entry points inside accumulator modules
+# come from the declarative policy table (ISSUE 16): ops/precision.py's
+# MIXED_PRECISION_POLICY["half_bindings"] lists (path, binding) pairs —
+# originally just vtrace_pallas.py's _FUSED_COMPUTE_DTYPES (ISSUE 13).
+# Only those assignment spans are exempt; any OTHER half token in
+# popart/vtrace modules still fires. The table is ast.literal_eval'd
+# (never imported, so the lint stays jax-free) from the scanned file
+# when present, else from the repo checkout.
+_POLICY_REL = "torched_impala_tpu/ops/precision.py"
+_POLICY_BINDING = "MIXED_PRECISION_POLICY"
 _STAT_NAME = re.compile(
     r"^(mu|nu|sigma|var|variance|mean|second_moment|first_moment"
     r"|m1|m2|moments?)$"
@@ -92,8 +103,110 @@ def _is_half(node: ast.expr) -> bool:
     return False
 
 
-def _half_token_lines(sf: SourceFile) -> List[int]:
-    allowed = _allowed_half_lines(sf)
+def _policy_assign(tree: ast.AST) -> Optional[ast.Assign]:
+    """The top-level MIXED_PRECISION_POLICY assignment node, if any."""
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == _POLICY_BINDING
+            for t in node.targets
+        ):
+            return node
+    return None
+
+
+_DISK_POLICY: Optional[Tuple[Optional[dict], Optional[ast.Assign]]] = None
+
+
+def _load_policy(
+    files: Sequence[SourceFile],
+) -> Tuple[Optional[dict], Optional[ast.Assign], str]:
+    """(table, assign-node, rel) — preferring a scanned policy file so
+    fixture tests can inject a synthetic table; falling back to the
+    checkout's copy (cached) so partial scans still see the real
+    allow-list."""
+    global _DISK_POLICY
+    for sf in files:
+        if sf.rel == _POLICY_REL and sf.tree is not None:
+            assign = _policy_assign(sf.tree)
+            if assign is not None:
+                try:
+                    return ast.literal_eval(assign.value), assign, sf.rel
+                except ValueError:
+                    return None, assign, sf.rel
+    if _DISK_POLICY is None:
+        table: Optional[dict] = None
+        assign: Optional[ast.Assign] = None
+        path = os.path.join(REPO, _POLICY_REL)
+        try:
+            with open(path, encoding="utf-8") as f:
+                assign = _policy_assign(ast.parse(f.read()))
+            if assign is not None:
+                table = ast.literal_eval(assign.value)
+        except (OSError, SyntaxError, ValueError):
+            table, assign = None, None
+        _DISK_POLICY = (table, assign)
+    return _DISK_POLICY[0], _DISK_POLICY[1], _POLICY_REL
+
+
+def _policy_findings(
+    assign: Optional[ast.Assign], rel: str
+) -> List[Finding]:
+    """Fire on any accumulator role the table declares non-f32."""
+    out: List[Finding] = []
+    if assign is None or not isinstance(assign.value, ast.Dict):
+        return out
+    for k, v in zip(assign.value.keys, assign.value.values):
+        if not (
+            isinstance(k, ast.Constant)
+            and k.value == "accumulators"
+            and isinstance(v, ast.Dict)
+        ):
+            continue
+        for rk, rv in zip(v.keys, v.values):
+            role = (
+                rk.value
+                if isinstance(rk, ast.Constant)
+                else ast.dump(rk)
+            )
+            if not (
+                isinstance(rv, ast.Constant) and rv.value == "float32"
+            ):
+                out.append(
+                    Finding(
+                        rule="dtype/policy-accumulator-not-f32",
+                        path=rel,
+                        line=getattr(rv, "lineno", assign.lineno),
+                        message=(
+                            f"accumulator role {role!r} declared "
+                            "non-float32 in MIXED_PRECISION_POLICY — "
+                            "optimizer/PopArt/V-trace accumulators "
+                            "are f32-only; compute surfaces belong "
+                            "under the 'compute' key"
+                        ),
+                        key=f"{rel}::policy-accum:{role}",
+                    )
+                )
+    return out
+
+
+def _allowed_half_bindings(
+    policy: Optional[dict],
+) -> Set[Tuple[str, str]]:
+    if not policy:
+        return set()
+    try:
+        return {
+            (str(rel), str(name))
+            for rel, name in policy.get("half_bindings", ())
+        }
+    except (TypeError, ValueError):
+        return set()
+
+
+def _half_token_lines(
+    sf: SourceFile, bindings: Set[Tuple[str, str]]
+) -> List[int]:
+    allowed = _allowed_half_lines(sf, bindings)
     out = []
     for node in ast.walk(sf.tree):
         if (
@@ -105,13 +218,11 @@ def _half_token_lines(sf: SourceFile) -> List[int]:
     return sorted(set(out))
 
 
-def _allowed_half_lines(sf: SourceFile) -> Set[int]:
+def _allowed_half_lines(
+    sf: SourceFile, bindings: Set[Tuple[str, str]]
+) -> Set[int]:
     """Line span of every allow-listed binding's assignment in `sf`."""
-    names = {
-        name
-        for rel, name in _ALLOWED_HALF_BINDINGS
-        if rel == sf.rel
-    }
+    names = {name for rel, name in bindings if rel == sf.rel}
     if not names:
         return set()
     lines: Set[int] = set()
@@ -190,12 +301,17 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
     half_ret = _half_returners(graph)
     findings: List[Finding] = []
 
+    # Rule 4: the policy table itself — accumulator roles must be f32.
+    policy, policy_assign, policy_rel = _load_policy(files)
+    findings.extend(_policy_findings(policy_assign, policy_rel))
+    bindings = _allowed_half_bindings(policy)
+
     for sf in files:
         if sf.tree is None:
             continue
         # Rule 1: f32-only modules
         if _ACCUM_MODULE.search(sf.rel):
-            for line in _half_token_lines(sf):
+            for line in _half_token_lines(sf, bindings):
                 findings.append(
                     Finding(
                         rule="dtype/half-in-accumulator-module",

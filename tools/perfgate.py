@@ -45,10 +45,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 
 # Absolute floors for the load-bearing full-bench numbers (frames/s/chip
-# on the tunnelled v5e; see BENCH_live.json for the current values).
+# on the tunnelled v5e; see docs/evidence/BENCH_live.json for the current values).
 # `fingerprint_contains` scopes each floor to the backend it was pinned
 # on — tiny CPU-CI records use their own `tiny_*` metric names and are
-# gated by the relative-drop check only.
+# gated by the relative-drop check only (except entries below that set
+# `no_drop_check`: dispatch-noise-dominated quotients keep just their
+# absolute budget).
 BUDGETS: Dict[str, Dict[str, Any]] = {
     "learner_frames_per_sec_per_chip_pong": {
         "min": 500_000.0,
@@ -102,6 +104,37 @@ BUDGETS: Dict[str, Dict[str, Any]] = {
     "serving_p99_ms": {
         "max": 50.0,
         "fingerprint_contains": "",
+    },
+    # ISSUE 16 compute-side MFU. TPU-scoped, unlike the other ratio
+    # budgets: bf16 is software-emulated on CPU and the Pallas kernels
+    # run in interpret mode there, so the speedup claims only hold on
+    # real MXUs (the CPU bench appends tiny_-prefixed rows instead).
+    # The full-bf16 step must beat f32 by >= 5%, the fused LSTM unroll
+    # must be no slower than the flax cell, and the B=1024 default
+    # operating point must clear 0.15 MFU on the v5e.
+    "train_dtype_step_ratio": {
+        "max": 0.95,
+        "fingerprint_contains": "tpu",
+    },
+    "lstm_fused_step_ratio": {
+        "max": 1.0,
+        "fingerprint_contains": "tpu",
+    },
+    "mfu_b1024": {
+        "min": 0.15,
+        "fingerprint_contains": "tpu",
+    },
+    # Dispatch-noise carve-out: the tiny mesh placement ratio divides
+    # two sub-millisecond host puts, so run-to-run it swings 0.55-1.1x
+    # on a shared CI box — a 20% median gate on it is a coin flip (the
+    # full-shape row keeps the normal drop check). `no_drop_check`
+    # skips the trailing-median comparison; the loose absolute ceiling
+    # still catches the direct-placement path genuinely losing to the
+    # reshard hop it replaced.
+    "tiny_mesh_feed_step_ratio": {
+        "max": 2.0,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
     },
 }
 
@@ -217,9 +250,10 @@ def check_records(
         value = float(newest["value"])
         higher = newest.get("direction", "higher") != "lower"
         budget = budgets.get(metric)
-        if budget is not None and budget.get(
+        budget_applies = budget is not None and budget.get(
             "fingerprint_contains", ""
-        ) in fingerprint:
+        ) in fingerprint
+        if budget_applies:
             floor = budget.get("min")
             ceil = budget.get("max")
             if floor is not None and value < floor:
@@ -232,6 +266,10 @@ def check_records(
                     f"{metric} [{fingerprint}]: {value:g} above pinned "
                     f"budget max {ceil:g} (sha {newest.get('sha')})"
                 )
+        if budget_applies and budget.get("no_drop_check"):
+            # Dispatch-noise-dominated metric: the absolute budget above
+            # is the whole gate for it.
+            continue
         prior = [float(r["value"]) for r in group[:-1][-window:]]
         if len(prior) < min_prior:
             continue

@@ -3,7 +3,7 @@
 # tunnel_watch.sh gives up after 60 iterations (~10h); this respawner
 # relaunches it whenever it has exited without having committed a fresh
 # TPU capture, so a late tunnel heal still gets benched. Exits once
-# BENCH_live.json carries a TPU backend newer than the round start.
+# docs/evidence/BENCH_live.json carries a TPU backend newer than the round start.
 cd /root/repo
 START_TS=$(date +%s)
 for i in $(seq 1 48); do
@@ -29,9 +29,9 @@ EOF
   fresh=$(python3 -c "
 import json, os
 try:
-    d = json.load(open('BENCH_live.json'))
+    d = json.load(open('docs/evidence/BENCH_live.json'))
     ok = (d.get('backend') == 'tpu' and 'feeder_saturation' in d
-          and os.path.getmtime('BENCH_live.json') > $START_TS)
+          and os.path.getmtime('docs/evidence/BENCH_live.json') > $START_TS)
 except Exception:
     ok = False
 print(1 if ok else 0)")

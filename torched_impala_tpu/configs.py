@@ -61,6 +61,11 @@ class ControlConfig:
     serving_slo_ms: float = 25.0
     checkpoint_overhead_budget: float = 0.01
     allow_recompile: bool = False
+    # Minimum spacing between permitted live re-jits (the RecompileGate's
+    # min_interval_s): with allow_recompile the B/K hill-climb on perf/mfu
+    # may take at most one recompiling step per cadence window, so the
+    # ~30s re-jit stall always has a full window to amortize (ISSUE 16).
+    recompile_cadence_s: float = 300.0
 
     def validate(self) -> None:
         if self.mode not in ("off", "auto"):
@@ -69,6 +74,8 @@ class ControlConfig:
             )
         if self.interval_s <= 0:
             raise ValueError("control interval_s must be > 0")
+        if self.recompile_cadence_s <= 0:
+            raise ValueError("control recompile_cadence_s must be > 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,6 +131,11 @@ class ExperimentConfig:
     # activations between passes — the standard lever when HBM, not MXU,
     # bounds the batch size (deep ResNet at large B/T; SURVEY.md §7).
     remat_torso: bool = False
+    # Run the deep-ResNet residual blocks through the fused Pallas block
+    # kernel (ops/conv_pallas.py): relu→conv→relu→conv→skip in one VMEM
+    # pass per image. Param-tree compatible with the unfused path;
+    # deep_resnet only. Opt-in (CPU interpret mode is strictly slower).
+    fused_conv: bool = False
     # Runtime: "actors" = host actor fleet feeding the device learner (the
     # reference's architecture); "anakin" = fully on-device actor-learner
     # for pure-JAX env families (runtime/anakin.py; env stepping fused into
@@ -180,16 +192,31 @@ class ExperimentConfig:
     # Zero-copy feed path (ISSUE 13, `--superbatch-k` bundles all
     # three pieces): donate ring slots straight into the compiled train
     # step (no host staging copy, slot released one step behind), run
-    # the loss epilogue fused with the V-trace recursion, and pick the
-    # [T, B, A] softmax/elementwise compute dtype (bf16 allowed; the
-    # recursion and all accumulators stay f32).
+    # the loss epilogue fused with the V-trace recursion.
     donate_batch: bool = False
     fused_epilogue: bool = False
+    # Train-step compute dtype (ISSUE 16; ops/precision.py policy role
+    # "train_step"): 'bfloat16' runs the FULL step — params and
+    # activations — in bf16 (params cast inside the loss closure, so
+    # the optimizer updates f32 master weights) and also selects the
+    # fused epilogue's [T, B, A] elementwise phase dtype when
+    # fused_epilogue is on. Optimizer moments, PopArt stats and the
+    # V-trace recursion stay f32 regardless; run.py gates bf16 behind
+    # a greedy-action parity probe and falls back to f32 on failure.
     train_dtype: str = "float32"
     total_env_frames: int = 1_000_000
     # Optimization.
     lr: float = 6e-4
     lr_anneal: bool = True  # linear anneal to 0 over total_env_frames
+    # Large-batch operating point (ISSUE 16; arxiv 1803.02811's
+    # linear-scaling playbook): when lr_scale_ref_batch > 0, the base
+    # lr is cfg.lr * (B*K / lr_scale_ref_batch) with B*K the effective
+    # batch (batch_size * steps_per_dispatch), and lr_warmup_steps
+    # learner steps ramp linearly 0 -> base before the anneal begins.
+    # Resume-mid-warmup is correct by construction: optax schedules
+    # index the restored optimizer step count.
+    lr_scale_ref_batch: int = 0
+    lr_warmup_steps: int = 0
     rmsprop_decay: float = 0.99
     rmsprop_eps: float = 1e-7  # paper uses 0.1 for Atari; analog 1e-7
     max_grad_norm: float = 40.0
@@ -301,7 +328,16 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
             f"{cfg.transformer_dense_kernel!r}; "
             "expected 'auto', 'pallas' or 'einsum'"
         )
+    from torched_impala_tpu.ops import precision
+
+    precision.validate_compute_dtype("train_step", cfg.train_dtype)
     dtype = jnp.dtype(cfg.compute_dtype)
+    if cfg.train_dtype == "bfloat16":
+        # Full-bf16 train step (ISSUE 16): activations follow the train
+        # compute dtype end-to-end. The heads and the recurrent core
+        # still cast to f32 (models/nets.py), matching the policy's
+        # lstm_carry / loss_reductions accumulator roles.
+        dtype = jnp.dtype("bfloat16")
     torso_cls = {
         "mlp": MLPTorso,
         "shallow_cnn": AtariShallowTorso,
@@ -316,7 +352,17 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
         import flax.linen as nn
 
         torso_cls = nn.remat(torso_cls)
-    torso = torso_cls(dtype=dtype)
+    torso_kwargs = {"dtype": dtype}
+    if cfg.model == "deep_resnet":
+        # Only the ResNet torso has residual blocks to fuse; the flag is
+        # a no-op (and rejected) elsewhere.
+        torso_kwargs["fused_blocks"] = cfg.fused_conv
+    elif cfg.fused_conv:
+        raise ValueError(
+            "fused_conv requires model='deep_resnet' "
+            f"(got model={cfg.model!r})"
+        )
+    torso = torso_cls(**torso_kwargs)
     # Dense-path attention math, resolved HERE against the actual compute
     # devices (mesh when given, default backend otherwise), mirroring the
     # learner's V-trace 'auto' resolution; the core itself refuses 'auto'.
@@ -369,19 +415,110 @@ def make_agent(cfg: ExperimentConfig, mesh=None) -> Agent:
     return Agent(net)
 
 
-def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
-    """RMSProp with the paper's linear anneal-to-zero schedule (per learner
-    step; the schedule length is total frames / frames-per-step)."""
-    if cfg.lr_anneal:
-        lr = optax.linear_schedule(
-            init_value=cfg.lr,
-            end_value=0.0,
-            transition_steps=cfg.total_learner_steps,
-        )
+def check_train_dtype_parity(
+    cfg: ExperimentConfig,
+    mesh=None,
+    *,
+    seed: int = 0,
+    batch: int = 8,
+    unroll: int = 4,
+) -> tuple[bool, int]:
+    """Train-side greedy-action parity gate for `train_dtype` (ISSUE
+    16; the serving gate's idiom — serving.greedy_action_parity):
+    argmax actions of the reduced-precision train forward (the bf16
+    agent unrolling bf16-cast params, exactly what the full-bf16 loss
+    closure runs) must equal the f32 reference on a fixed `[T, B]`
+    probe. Returns (ok, mismatches over T*B probe actions). Callers
+    refuse the half dtype and fall back to f32 on failure (run.py's
+    warning path; doctor's "mixed precision" row), mirroring how
+    serving refuses a failing bf16/int8 cast. Deterministic: argmax
+    needs no sampling key."""
+    import jax
+
+    from torched_impala_tpu.ops import precision
+
+    if cfg.train_dtype == "float32":
+        return True, 0
+    agent_ref = make_agent(
+        dataclasses.replace(cfg, train_dtype="float32"), mesh=mesh
+    )
+    agent_half = make_agent(cfg, mesh=mesh)
+    example = example_obs(cfg)
+    rng = np.random.default_rng(seed)
+    shape = (unroll, batch, *example.shape)
+    if example.dtype == np.uint8:
+        probe = rng.integers(0, 256, size=shape, dtype=np.uint8)
     else:
-        lr = cfg.lr
+        probe = rng.normal(size=shape).astype(example.dtype)
+    probe = jnp.asarray(probe)
+    first = jnp.zeros((unroll, batch), jnp.bool_).at[0].set(True)
+    params = agent_ref.init_params(
+        jax.random.key(seed), jnp.asarray(example)
+    )
+
+    def greedy(agent, p):
+        out, _ = agent.unroll(p, probe, first, agent.initial_state(batch))
+        return np.asarray(jnp.argmax(out.policy_logits, axis=-1))
+
+    a_ref = greedy(agent_ref, params)
+    a_half = greedy(
+        agent_half, precision.cast_to_compute(params, cfg.train_dtype)
+    )
+    mismatches = int(np.sum(a_ref != a_half))
+    return mismatches == 0, mismatches
+
+
+def scaled_base_lr(cfg: ExperimentConfig) -> float:
+    """cfg.lr linearly scaled by effective batch (B*K) against the
+    reference batch, per the large-batch playbook (arxiv 1803.02811).
+    `lr_scale_ref_batch == 0` disables scaling."""
+    if cfg.lr_scale_ref_batch <= 0:
+        return cfg.lr
+    effective_batch = cfg.batch_size * max(1, cfg.steps_per_dispatch)
+    return cfg.lr * (effective_batch / cfg.lr_scale_ref_batch)
+
+
+def make_lr_schedule(cfg: ExperimentConfig):
+    """The learning-rate schedule (or constant): optional linear warmup
+    over `lr_warmup_steps` learner steps from 0 to the (batch-scaled)
+    base lr, then the paper's linear anneal-to-zero over the remaining
+    steps (or a constant tail with lr_anneal=False). Schedules are
+    indexed by the optimizer's step count, so a checkpoint restored
+    mid-warmup resumes at the right point on the ramp."""
+    base_lr = scaled_base_lr(cfg)
+    warmup = max(0, cfg.lr_warmup_steps)
+    if cfg.lr_anneal:
+        tail = optax.linear_schedule(
+            init_value=base_lr,
+            end_value=0.0,
+            transition_steps=max(1, cfg.total_learner_steps - warmup),
+        )
+    elif warmup:
+        tail = optax.constant_schedule(base_lr)
+    else:
+        return base_lr
+    if warmup:
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(
+                    init_value=0.0,
+                    end_value=base_lr,
+                    transition_steps=warmup,
+                ),
+                tail,
+            ],
+            [warmup],
+        )
+    return tail
+
+
+def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
+    """RMSProp under `make_lr_schedule` (warmup + linear-scaled base lr
+    when configured, the paper's linear anneal-to-zero either way)."""
     return optax.rmsprop(
-        lr, decay=cfg.rmsprop_decay, eps=cfg.rmsprop_eps
+        make_lr_schedule(cfg),
+        decay=cfg.rmsprop_decay,
+        eps=cfg.rmsprop_eps,
     )
 
 
@@ -412,6 +549,7 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
         steps_per_dispatch=cfg.steps_per_dispatch,
         traj_ring=cfg.traj_ring,
         donate_batch=cfg.donate_batch,
+        train_dtype=cfg.train_dtype,
         replay=replay,
         popart=(
             PopArtConfig(

@@ -221,14 +221,18 @@ def build_train_control(
     checkpoint_overhead_budget: float = 0.01,
     staleness_budget_frames: float = 0.0,
     allow_recompile: bool = False,
+    recompile_cadence_s: float = 300.0,
     telemetry=None,
     tracer=None,
 ) -> ControlLoop:
     """The training-side loop: fused-K chunking hill-climbs on MFU,
     replay ``max_reuse`` tracks its staleness budget, checkpoint cadence
     tracks its overhead budget, ``replay_mix`` is a registered hot-apply
-    surface (no default policy), and B/K are registered behind the
-    default-deny recompile gate so proposals are audited but not taken.
+    surface (no default policy), and B/K hill-climb on the same MFU
+    signal behind the recompile gate: with ``allow_recompile`` the gate
+    opens at most once per ``recompile_cadence_s`` (the re-jit stall
+    gets a full window to amortize); default-deny keeps every proposal
+    audited but refused, exactly the pre-ISSUE-16 behavior.
 
     Every collaborator is optional: pass only the pieces a given run
     actually has and the rest of the knob set is simply absent.
@@ -344,7 +348,14 @@ def build_train_control(
             ),
         )
 
-    gate = RecompileGate(allow=allow_recompile)
+    gate = RecompileGate(
+        allow=allow_recompile, min_interval_s=recompile_cadence_s
+    )
+    # The B/K knobs share the MFU objective with the fused-chunk climb
+    # (one signal, consistent direction) but each binding keeps its own
+    # EWMA/cooldown state. The knobs carry recompile=True, so every
+    # proposed move still runs through `gate` inside Knob.propose —
+    # binding a policy changes who *proposes*, not what is *permitted*.
     if batch_size:
         # Under a data-parallel mesh every proposed B must stay
         # divisible by the data-axis size (the learner refuses a
@@ -356,7 +367,7 @@ def build_train_control(
         def _q(v: int) -> int:  # round up to a shard multiple, >= n
             return max(n, ((int(v) + n - 1) // n) * n)
 
-        loop.add_knob(
+        loop.bind(
             Knob(
                 KnobSpec(
                     "batch_size",
@@ -365,16 +376,25 @@ def build_train_control(
                     lo=_q(max(1, batch_size // 2)),
                     hi=max(2.0 * n, 4.0 * batch_size),
                     step=_q(max(1, batch_size // 2)),
+                    # Recompiles need the full cadence window to judge,
+                    # not the hot-apply settle.
+                    settle_s=recompile_cadence_s,
                     kind="int",
                     recompile=True,
                 ),
                 gate=gate,
                 initial=batch_size,
                 telemetry=telemetry,
-            )
+            ),
+            HillClimbPolicy(
+                EwmaSignal(GaugeSignal("perf/mfu")),
+                tolerance=tolerance,
+                hysteresis=hysteresis,
+                cooldown_s=max(cooldown_s, recompile_cadence_s),
+            ),
         )
     if steps_per_dispatch:
-        loop.add_knob(
+        loop.bind(
             Knob(
                 KnobSpec(
                     "steps_per_dispatch",
@@ -386,13 +406,20 @@ def build_train_control(
                         max(SUPERBATCH_MAX_K, 2 * steps_per_dispatch)
                     ),
                     step=1,
+                    settle_s=recompile_cadence_s,
                     kind="int",
                     recompile=True,
                 ),
                 gate=gate,
                 initial=steps_per_dispatch,
                 telemetry=telemetry,
-            )
+            ),
+            HillClimbPolicy(
+                EwmaSignal(GaugeSignal("perf/mfu")),
+                tolerance=tolerance,
+                hysteresis=hysteresis,
+                cooldown_s=max(cooldown_s, recompile_cadence_s),
+            ),
         )
     return loop
 

@@ -1210,6 +1210,90 @@ def _train_probe(config_name: str) -> tuple[str, str]:
         return "FAIL", f"train probe raised:\n{traceback.format_exc()}"
 
 
+def _check_mixed_precision() -> tuple[str, str]:
+    """Mixed-precision policy self-check (docs/OBSERVABILITY.md,
+    ISSUE 16): (a) a tiny full-bf16 train forward must pass the
+    greedy-action parity gate against f32 (the run.py --train-dtype
+    gate); (b) seeded bf16 PopArt statistics must be REFUSED by the
+    accumulator assertion Learner.__init__/set_state run (a rogue
+    half-precision accumulator is silent return corruption); (c) the
+    fused Pallas LSTM cell must match the flax reference on a fixed
+    probe within the documented ~1-ulp tolerance."""
+    import dataclasses
+
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from torched_impala_tpu import configs
+        from torched_impala_tpu.ops import precision
+
+        cfg = dataclasses.replace(
+            configs.REGISTRY["cartpole"], train_dtype="bfloat16"
+        )
+        ok, mismatches = configs.check_train_dtype_parity(
+            cfg, seed=0, batch=8, unroll=4
+        )
+        if not ok:
+            return "FAIL", (
+                f"bf16 train step failed the greedy parity gate "
+                f"({mismatches} probe actions differ from f32)"
+            )
+
+        # (b) the refusal path: bf16 PopArt stats must raise.
+        bad_stats = {
+            "mu": jnp.zeros((4,), jnp.bfloat16),
+            "nu": jnp.ones((4,), jnp.float32),
+        }
+        try:
+            precision.assert_f32_accumulators(
+                {"popart_stats": bad_stats}, context="doctor"
+            )
+            return "FAIL", (
+                "seeded bfloat16 PopArt statistics were ACCEPTED by "
+                "the f32-accumulator assertion"
+            )
+        except ValueError:
+            pass
+
+        # (c) fused Pallas LSTM vs the flax cell on a fixed probe.
+        import flax.linen as nn
+
+        from torched_impala_tpu.models.lstm import PallasLSTMCell
+
+        rng = np.random.default_rng(0)
+        B, F, H = 4, 6, 8
+        x = jnp.asarray(rng.normal(size=(B, F)), jnp.float32)
+        carry = (
+            jnp.asarray(rng.normal(size=(B, H)), jnp.float32),
+            jnp.asarray(rng.normal(size=(B, H)), jnp.float32),
+        )
+        ref_cell = nn.OptimizedLSTMCell(H)
+        fused_cell = PallasLSTMCell(H)
+        params = ref_cell.init(jax.random.key(0), carry, x)
+        (c_ref, h_ref), _ = ref_cell.apply(params, carry, x)
+        (c_f, h_f), _ = fused_cell.apply(params, carry, x)
+        diff = max(
+            float(jnp.max(jnp.abs(c_ref - c_f))),
+            float(jnp.max(jnp.abs(h_ref - h_f))),
+        )
+        if diff > 1e-6:
+            return "FAIL", (
+                f"fused Pallas LSTM diverges from the flax cell by "
+                f"{diff:.2e} on the fixed probe (tolerance 1e-6)"
+            )
+        return "ok", (
+            "bf16 parity gate passed, bf16 PopArt stats refused, "
+            f"fused LSTM within {diff:.1e} of flax"
+        )
+    except Exception:
+        return "FAIL", (
+            f"mixed-precision probe raised:\n{traceback.format_exc()}"
+        )
+
+
 def run_doctor(config_name: str | None = None) -> int:
     print("== torched_impala_tpu doctor ==")
     print(f"python {sys.version.split()[0]}")
@@ -1284,6 +1368,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_control()
     print(f"  control    [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_mixed_precision()
+    print(f"  mixed precision [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

@@ -1,0 +1,68 @@
+"""PallasLSTMCell: flax-compatible wrapper over the fused LSTM kernel.
+
+Drop-in replacement for `nn.OptimizedLSTMCell` inside `ImpalaNet` (ISSUE
+16): the param tree is BIT-IDENTICAL — the same `DenseParams` submodules
+flax's cell uses, under the same names (`i{i,f,g,o}` input kernels,
+`h{i,f,g,o}` recurrent kernels + biases) with the same default
+initializers (lecun-normal input kernels, orthogonal recurrent kernels,
+zero biases) — so checkpoints, the TP `model_shardings`, and the PopArt
+value-head addressing are all unaffected by switching implementations
+(`ImpalaNet.lstm_impl`, pinned by tests/test_pallas_lstm.py).
+
+The compute runs through `ops.lstm_pallas.lstm_cell_fused`: one Pallas
+pass over both gate matmuls and all elementwise gates, with an analytic
+VJP (interpret mode off-TPU, so CPU tier-1 exercises the same kernel).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import initializers
+from flax.linen.recurrent import DenseParams
+
+from torched_impala_tpu.ops.lstm_pallas import lstm_cell_fused
+
+
+class PallasLSTMCell(nn.Module):
+    """LSTM cell with `OptimizedLSTMCell`'s param tree and numerics,
+    computed by the fused Pallas kernel. Carry is `(c, h)`; returns
+    `((new_c, new_h), new_h)` — the flax cell contract `_core_step`
+    scans over."""
+
+    features: int
+
+    @nn.compact
+    def __call__(
+        self, carry: tuple[jax.Array, jax.Array], inputs: jax.Array
+    ) -> tuple[tuple[jax.Array, jax.Array], jax.Array]:
+        c, h = carry
+        # Same submodule names, creation order, and initializers as
+        # OptimizedLSTMCell — identical RNG paths, so init params match
+        # the flax cell bitwise.
+        params_i = {}
+        params_h = {}
+        for component in ("i", "f", "g", "o"):
+            params_i[component] = DenseParams(
+                features=self.features,
+                use_bias=False,
+                name=f"i{component}",
+            )(inputs)
+            params_h[component] = DenseParams(
+                features=self.features,
+                use_bias=True,
+                kernel_init=initializers.orthogonal(),
+                name=f"h{component}",
+            )(h)
+        wi = jnp.concatenate(
+            [params_i[k][0] for k in ("i", "f", "g", "o")], axis=-1
+        )
+        wh = jnp.concatenate(
+            [params_h[k][0] for k in ("i", "f", "g", "o")], axis=-1
+        )
+        b = jnp.concatenate(
+            [params_h[k][1] for k in ("i", "f", "g", "o")], axis=-1
+        )
+        new_c, new_h = lstm_cell_fused(inputs, h, c, wi, wh, b)
+        return (new_c, new_h), new_h

@@ -72,6 +72,12 @@ class ImpalaNet(nn.Module):
       transformer: TransformerCore hyper-parameters, used when
         core="transformer" (a dict so the module stays hashable; keys are
         TransformerCore fields).
+      lstm_impl: "fused" (default) computes the LSTM cell with the
+        single-pass Pallas kernel (ops/lstm_pallas.py; interpret mode
+        off-TPU), "flax" keeps nn.OptimizedLSTMCell. Both produce a
+        bitwise-identical param tree and outputs within ~1 ulp in f32 —
+        an escape hatch, not a checkpoint fork (tests/test_pallas_lstm.py
+        pins the tolerance).
       num_values: width of the value head (1, or num_tasks under PopArt).
     """
 
@@ -81,6 +87,7 @@ class ImpalaNet(nn.Module):
     core: str = "auto"  # "auto" resolves via use_lstm for back-compat
     lstm_size: int = 256
     transformer: tuple = ()  # e.g. (("d_model", 128), ("num_layers", 2))
+    lstm_impl: str = "fused"
     num_values: int = 1
 
     def _core_kind(self) -> str:
@@ -165,7 +172,17 @@ class ImpalaNet(nn.Module):
             # dtype must be stable across steps, and the LSTM is a
             # negligible share of the FLOPs next to the convs on the MXU.
             features = features.astype(jnp.float32)
-            cell = nn.OptimizedLSTMCell(self.lstm_size, name="lstm")
+            if self.lstm_impl == "flax":
+                cell = nn.OptimizedLSTMCell(self.lstm_size, name="lstm")
+            elif self.lstm_impl == "fused":
+                from torched_impala_tpu.models.lstm import PallasLSTMCell
+
+                cell = PallasLSTMCell(self.lstm_size, name="lstm")
+            else:
+                raise ValueError(
+                    f"unknown lstm_impl {self.lstm_impl!r}; "
+                    "expected 'fused' or 'flax'"
+                )
             if unroll:
                 scan = nn.scan(
                     _core_step,

@@ -213,14 +213,53 @@ class AtariShallowTorso(nn.Module):
         return nn.relu(nn.Dense(512, dtype=self.dtype)(x))
 
 
+class _ConvParams(nn.Module):
+    """Param-only 3x3 conv holder: same param names, shapes, and default
+    initializers as `nn.Conv(features, (3, 3))`, so a `ResidualBlock`
+    with `fused=True` has a param tree bitwise identical to the
+    reference branch (the submodule is named `Conv_0`/`Conv_1`, matching
+    flax's auto-naming — same RNG paths at init, same checkpoint
+    layout)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (3, 3, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+        return kernel, bias
+
+
 class ResidualBlock(nn.Module):
-    """Two 3x3 convs with a skip connection (analog `haiku_nets.py:79-101`)."""
+    """Two 3x3 convs with a skip connection (analog `haiku_nets.py:79-101`).
+
+    With `fused=True` the whole block — relu, both convs, the skip add —
+    runs as one Pallas kernel per image (`ops/conv_pallas.py`), keeping
+    the intermediate activation in VMEM instead of round-tripping each
+    stage through HBM. Same param tree either way; outputs agree to
+    ulp-level f32 tolerance (tests/test_pallas_conv.py)."""
 
     channels: int
     dtype: jnp.dtype = jnp.float32
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        if self.fused:
+            from torched_impala_tpu.ops.conv_pallas import (
+                fused_residual_block,
+            )
+
+            k1, b1 = _ConvParams(self.channels, name="Conv_0")(x)
+            k2, b2 = _ConvParams(self.channels, name="Conv_1")(x)
+            return fused_residual_block(x.astype(self.dtype), k1, b1, k2, b2)
         out = nn.relu(x)
         out = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(out)
         out = nn.relu(out)
@@ -237,6 +276,11 @@ class AtariDeepTorso(nn.Module):
     blocks_per_section: int = 2
     hidden_size: int = 256
     dtype: jnp.dtype = jnp.float32
+    # Route residual blocks through the fused Pallas block kernel
+    # (ops/conv_pallas.py; `--fused-conv`). Param-tree compatible with
+    # the unfused path — opt-in because the win is TPU memory-bandwidth
+    # bound and CPU interpret mode is strictly slower.
+    fused_blocks: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -255,7 +299,9 @@ class AtariDeepTorso(nn.Module):
                 x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
             )
             for _ in range(self.blocks_per_section):
-                x = ResidualBlock(channels, dtype=self.dtype)(x)
+                x = ResidualBlock(
+                    channels, dtype=self.dtype, fused=self.fused_blocks
+                )(x)
         x = nn.relu(x)
         x = x.reshape(*x.shape[:-3], -1)
         return nn.relu(nn.Dense(self.hidden_size, dtype=self.dtype)(x))

@@ -50,10 +50,15 @@ class ImpalaLossConfig:
     # analytic elementwise VJP. False = the exact pre-existing separate
     # epilogue, op for op.
     fused_epilogue: bool = False
-    # Compute dtype of the fused epilogue's [T, B, A] softmax /
-    # elementwise phase ('float32' or 'bfloat16'). Only consulted when
-    # fused_epilogue is on; recursion, reductions, and PopArt stats stay
-    # f32 regardless (the accumulator contract tools/lint polices).
+    # Train compute dtype ('float32' or 'bfloat16'; the ops/precision.py
+    # "train_step"/"fused_epilogue_elementwise" policy roles). Here it
+    # selects the fused epilogue's [T, B, A] softmax/elementwise phase
+    # dtype when fused_epilogue is on; the SAME config value drives the
+    # full-bf16 step's params/activations cast in the Learner
+    # (LearnerConfig.train_dtype — one consistent surface via
+    # configs.make_learner_config). Recursion, reductions, and PopArt
+    # stats stay f32 regardless (the accumulator contract tools/lint
+    # polices).
     train_dtype: str = "float32"
 
 

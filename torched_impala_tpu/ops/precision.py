@@ -1,0 +1,166 @@
+"""Declarative mixed-precision policy: the single source of truth for
+which roles may run in half precision and which must stay float32.
+
+The MFU push runs compute in bfloat16 on two surfaces — the torso /
+core / heads of the full-bf16 train step (``--train-dtype bfloat16``)
+and the fused V-trace epilogue's [T, B, A] elementwise phase — while
+every *accumulator* stays float32:
+
+- **optimizer state** (RMSProp/Adam moments): second moments underflow
+  in bf16's 8 mantissa bits;
+- **PopArt statistics** (mu / nu / sigma): the running second moment
+  loses the small-return tail, and the de/re-normalization of the
+  value head amplifies the error each update;
+- **V-trace recursion**: the backward scan accumulates products of
+  per-step corrections — rounding compounds over T;
+- **loss reductions**: means over [T, B] of bf16 terms drift;
+- **master params**: the optimizer updates f32 weights; bf16 is a cast
+  applied *inside* the loss closure (so gradients transpose back to
+  f32 through ``convert_element_type``).
+
+``MIXED_PRECISION_POLICY`` below is a pure literal on purpose: the
+dtype lint checker (tools/lint/dtypes.py) AST-parses this file and
+``ast.literal_eval``s the table without importing jax, validates every
+accumulator role is float32, and derives its half-precision allow-list
+from ``half_bindings``. Editing the table is the one sanctioned way to
+move the precision boundary — a hand-rolled bf16 accumulator anywhere
+else fires ``dtype/half-in-accumulator-module`` or
+``dtype/policy-accumulator-not-f32``.
+
+Runtime mirrors of this static policy:
+
+- the train-side parity gate (run.py): a greedy-action parity probe
+  (serving's ``greedy_action_parity`` idiom) must pass before a bf16
+  train step is accepted; on failure the run falls back to f32;
+- ``assert_f32_accumulators`` below: the Learner refuses checkpoints /
+  restored state whose optimizer or PopArt leaves are half precision;
+- ``doctor``'s "mixed precision" row exercises both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+# ---------------------------------------------------------------------------
+# The policy table. PURE LITERAL — parsed by tools/lint/dtypes.py via
+# ast.literal_eval; no names, calls, or comprehensions allowed here.
+# ---------------------------------------------------------------------------
+MIXED_PRECISION_POLICY = {
+    # Roles that accumulate across steps/time: float32 ONLY. The lint
+    # fires dtype/policy-accumulator-not-f32 on any other value.
+    "accumulators": {
+        "optimizer_state": "float32",
+        "popart_stats": "float32",
+        "vtrace_recursion": "float32",
+        "loss_reductions": "float32",
+        "lstm_carry": "float32",
+        "master_params": "float32",
+    },
+    # Compute surfaces and the dtypes each may run in. "train_step"
+    # covers the full-bf16 step (params+activations cast inside the
+    # loss closure); "fused_epilogue_elementwise" is the [T, B, A]
+    # softmax/elementwise phase of ops/vtrace_pallas.py.
+    "compute": {
+        "torso": ("float32", "bfloat16"),
+        "transformer_core": ("float32", "bfloat16"),
+        "train_step": ("float32", "bfloat16"),
+        "fused_epilogue_elementwise": ("float32", "bfloat16"),
+        "serving": ("float32", "bfloat16", "int8"),
+    },
+    # (repo-relative path, binding name) pairs sanctioned to carry
+    # half-precision dtype tokens inside popart/vtrace-named modules.
+    # tools/lint/dtypes.py exempts exactly these assignment spans from
+    # dtype/half-in-accumulator-module; every other half token there
+    # still fires.
+    "half_bindings": (
+        ("torched_impala_tpu/ops/vtrace_pallas.py", "_FUSED_COMPUTE_DTYPES"),
+    ),
+}
+
+
+def compute_dtypes(role: str) -> Tuple[str, ...]:
+    """Allowed compute dtypes for `role` (KeyError on unknown role)."""
+    return tuple(MIXED_PRECISION_POLICY["compute"][role])
+
+
+def accumulator_roles() -> Dict[str, str]:
+    return dict(MIXED_PRECISION_POLICY["accumulators"])
+
+
+def validate_compute_dtype(role: str, dtype: str) -> str:
+    """Return `dtype` if the policy allows it for `role`, else raise."""
+    try:
+        allowed = compute_dtypes(role)
+    except KeyError:
+        raise ValueError(
+            f"unknown mixed-precision role {role!r}; known roles: "
+            f"{tuple(MIXED_PRECISION_POLICY['compute'])}"
+        ) from None
+    if dtype not in allowed:
+        raise ValueError(
+            f"dtype {dtype!r} is not in the mixed-precision policy for "
+            f"{role!r} (allowed: {allowed}); edit "
+            "ops/precision.py:MIXED_PRECISION_POLICY to move the "
+            "precision boundary"
+        )
+    return dtype
+
+
+def cast_to_compute(tree: Any, dtype: Any) -> Any:
+    """Cast every floating leaf of `tree` to `dtype` (non-float leaves
+    pass through). Used inside the loss closure to lower the f32 master
+    params to the train compute dtype — gradients come back f32 via the
+    convert_element_type transpose, so optimizer state never sees bf16.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype)
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(
+            leaf.dtype, jnp.floating
+        ):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree.map(cast, tree)
+
+
+def half_leaves(tree: Any) -> Dict[str, str]:
+    """{path: dtype} for every sub-f32 floating leaf of `tree`."""
+    import jax
+    import jax.numpy as jnp
+
+    out: Dict[str, str] = {}
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves:
+        if not hasattr(leaf, "dtype"):
+            continue
+        dt = jnp.dtype(leaf.dtype)
+        if jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4:
+            out[jax.tree_util.keystr(path)] = dt.name
+    return out
+
+
+def assert_f32_accumulators(
+    trees: Mapping[str, Any], *, context: str
+) -> None:
+    """Refuse half-precision accumulator state.
+
+    `trees` maps an accumulator role name (e.g. "popart_stats",
+    "optimizer_state") to its pytree. Any floating leaf below 32 bits
+    raises ValueError naming the leaf — the Learner calls this on init
+    and on set_state so a corrupted checkpoint (bf16 PopArt stats, a
+    half optimizer moment) is refused instead of silently degrading.
+    """
+    bad = []
+    for role, tree in trees.items():
+        for path, dtype in half_leaves(tree).items():
+            bad.append(f"{role}{path}={dtype}")
+    if bad:
+        raise ValueError(
+            f"{context}: half-precision accumulator state refused "
+            f"(policy: ops/precision.py accumulators are f32-only): "
+            + ", ".join(sorted(bad))
+        )

@@ -26,6 +26,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from torched_impala_tpu.ops import precision
 from torched_impala_tpu.ops.vtrace import VTraceOutput
 
 _LANES = 128
@@ -192,11 +193,14 @@ def vtrace_pallas(
 # CPU XLA); the analytic VJP sidesteps that entirely.
 
 # Compute dtypes the fused epilogue accepts for its softmax/elementwise
-# phase. bf16 is the explicitly allow-listed half-precision entry point
-# (tools/lint/dtypes.py): ONLY the [T, B, A] elementwise phase may run
-# in bf16 — the V-trace recursion, loss reductions, and PopArt stats
+# phase, drawn from the declarative mixed-precision policy table
+# (ops/precision.py, ISSUE 16 — the single source of truth the dtype
+# lint validates): ONLY the [T, B, A] elementwise phase may run in
+# bf16 — the V-trace recursion, loss reductions, and PopArt stats
 # stay f32 (the accumulator contract the lint rule polices).
-_FUSED_COMPUTE_DTYPES = ("float32", "bfloat16")
+_FUSED_COMPUTE_DTYPES = precision.compute_dtypes(
+    "fused_epilogue_elementwise"
+)
 
 
 def _fused_loss_kernel(

@@ -106,10 +106,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "analytic VJP (ops/vtrace_pallas.py)")
     p.add_argument("--train-dtype", choices=("float32", "bfloat16"),
                    default=None,
-                   help="compute dtype for the fused epilogue's [T, B, A] "
-                        "softmax/elementwise phase; recursion and "
-                        "accumulators stay f32 (bfloat16 needs "
-                        "--fused-epilogue)")
+                   help="train-step compute dtype: bfloat16 runs the FULL "
+                        "step (params+activations cast inside the loss "
+                        "closure; optimizer/PopArt/V-trace accumulators "
+                        "stay f32 — ops/precision.py policy) and also "
+                        "selects the fused epilogue's [T, B, A] phase "
+                        "dtype under --fused-epilogue; gated by a "
+                        "greedy-action parity probe that falls back to "
+                        "float32 on failure")
     p.add_argument("--grad-accum", type=int, default=None,
                    help="accumulate gradients over G microbatches before "
                         "one optimizer update (same numbers as the full "
@@ -162,6 +166,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="rematerialize the torso in the backward pass "
                         "(trades an extra forward for not storing its "
                         "activations; for HBM-bound batch sizes)")
+    p.add_argument("--fused-conv", action="store_true",
+                   help="run deep-ResNet residual blocks as one fused "
+                        "Pallas kernel each (ops/conv_pallas.py); "
+                        "deep_resnet only, param-tree compatible")
     p.add_argument("--stack-buffer-reuse", choices=("auto", "on", "off"),
                    default="auto",
                    help="stack batches into a ring of reused preallocated "
@@ -332,6 +340,8 @@ def build_config(args: argparse.Namespace):
             overrides[field] = v
     if args.remat_torso:
         overrides["remat_torso"] = True
+    if args.fused_conv:
+        overrides["fused_conv"] = True
     if args.traj_ring:
         overrides["traj_ring"] = True
     if args.fused_epilogue:
@@ -510,6 +520,27 @@ def main(argv=None) -> int:
         mesh = make_mesh(num_data=n)
 
     agent = configs.make_agent(cfg, mesh=mesh)
+
+    if args.mode == "train" and cfg.train_dtype != "float32":
+        # The train-side parity gate (ISSUE 16; the serving bf16/int8
+        # gate's idiom): the reduced-precision train forward must agree
+        # with f32 on greedy actions over a fixed probe. Unlike serving
+        # (which exits rc=5 — the caller picked an explicit serve
+        # dtype), training REFUSES the half dtype and falls back to the
+        # exact f32 step: the run proceeds, just without the speedup.
+        ok, mismatches = configs.check_train_dtype_parity(
+            cfg, mesh=mesh, seed=args.seed
+        )
+        if not ok:
+            print(
+                f"warning: --train-dtype {cfg.train_dtype} refused — "
+                f"greedy-action parity gate failed ({mismatches} probe "
+                "actions differ from f32); falling back to float32 "
+                "(docs/OBSERVABILITY.md mixed-precision policy)",
+                file=sys.stderr,
+            )
+            cfg = dataclasses.replace(cfg, train_dtype="float32")
+            agent = configs.make_agent(cfg, mesh=mesh)
 
     # Checkpoint cadence/retention: flags override the preset fields
     # (configs.ExperimentConfig resilience block).

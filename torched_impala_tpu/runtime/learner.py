@@ -34,6 +34,7 @@ from jax.sharding import Mesh
 
 from torched_impala_tpu.models.agent import Agent
 from torched_impala_tpu.ops import popart as popart_ops
+from torched_impala_tpu.ops import precision
 from torched_impala_tpu.ops import vtrace as vtrace_ops
 from torched_impala_tpu.ops.losses import (
     SUM_REDUCED_LOG_KEYS,
@@ -164,6 +165,16 @@ class LearnerConfig:
     # keeps the exact pre-existing path. Incompatible with replay (a
     # retained slot's contents must survive for re-delivery).
     donate_batch: bool = False
+    # Full-bf16 train step (ISSUE 16; ops/precision.py "train_step"
+    # role): 'bfloat16' casts the f32 master params to bf16 INSIDE the
+    # loss closure, so the forward/backward runs in half precision
+    # while gradients transpose back to f32 (convert_element_type) and
+    # the optimizer, PopArt stats and V-trace recursion never see bf16
+    # — the accumulator contract `precision.assert_f32_accumulators`
+    # enforces on init and set_state. 'float32' (default) is the exact
+    # pre-existing step. run.py gates bf16 behind a greedy-action
+    # parity probe and falls back to f32 when the probe fails.
+    train_dtype: str = "float32"
     # Backend NAME ("cpu") the batcher device_puts assembled batches to,
     # instead of the default device. A measurement/staging knob (bench's
     # feeder section uses it to time the ingest path against the local
@@ -406,6 +417,14 @@ class Learner:
             if config.data_device is not None
             else None
         )
+        # Full-bf16 step (ISSUE 16): the loss closures cast the f32
+        # master params to this dtype; None = the exact f32 path.
+        precision.validate_compute_dtype("train_step", config.train_dtype)
+        self._train_cast = (
+            jnp.dtype(config.train_dtype)
+            if config.train_dtype != "float32"
+            else None
+        )
         if config.loss.vtrace_implementation == "auto":
             # Resolve 'auto' HERE, where the compute devices are known: the
             # trace-time fallback inside ops.vtrace keys off the default
@@ -458,6 +477,17 @@ class Learner:
             popart_ops.init(config.popart.num_values)
             if config.popart is not None
             else ()
+        )
+        # Accumulators are f32-only regardless of train_dtype (the
+        # ops/precision.py policy); a half-precision optimizer moment
+        # or PopArt stat here means a mis-built optimizer/init — refuse
+        # now, before it corrupts training slowly and invisibly.
+        precision.assert_f32_accumulators(
+            {
+                "optimizer_state": self._opt_state,
+                "popart_stats": self._popart_state,
+            },
+            context="Learner.__init__",
         )
         if mesh is not None:
             rep = replicated(mesh)
@@ -884,6 +914,13 @@ class Learner:
         pa_cfg = self._config.popart
 
         def loss_fn(p):
+            if self._train_cast is not None:
+                # Full-bf16 step: lower the f32 master params to the
+                # train compute dtype inside the differentiated
+                # closure — the convert_element_type transpose brings
+                # gradients back as f32, so grads/optimizer/PopArt
+                # stay on the f32 accumulator contract.
+                p = precision.cast_to_compute(p, self._train_cast)
             discounts = cfg.discount * cont
             if pa_cfg is None:
                 net_out, _ = self._agent.unroll(p, obs, first, agent_state)
@@ -1094,6 +1131,12 @@ class Learner:
         cfg = self._config.loss
         rp = self._config.replay
         pa_cfg = self._config.popart
+        if self._train_cast is not None:
+            # The gradient-free target anchor runs at the same train
+            # compute dtype as the learner forward it clips against.
+            target_params = precision.cast_to_compute(
+                target_params, self._train_cast
+            )
         target_out, _ = self._agent.unroll(
             target_params, obs, first, agent_state
         )
@@ -1102,6 +1145,9 @@ class Learner:
         )
 
         def loss_fn(p):
+            if self._train_cast is not None:
+                # Same master-params-in-f32 contract as _compute_grads.
+                p = precision.cast_to_compute(p, self._train_cast)
             if pa_cfg is None:
                 net_out, _ = self._agent.unroll(
                     p, obs, first, agent_state
@@ -2299,6 +2345,19 @@ class Learner:
                     popart_state = popart_ops.PopArtState(**popart_state)
                 else:
                     popart_state = popart_ops.PopArtState(*popart_state)
+        # Refuse half-precision accumulator state BEFORE it replaces the
+        # live f32 state: a checkpoint whose optimizer moments or PopArt
+        # stats were saved in bf16 (seeded corruption, a foreign writer)
+        # would degrade training silently — the ops/precision.py policy
+        # says accumulators are f32-only, enforced here at the restore
+        # boundary (the doctor's "mixed precision" row probes this).
+        precision.assert_f32_accumulators(
+            {
+                "optimizer_state": opt_state,
+                "popart_stats": popart_state,
+            },
+            context="Learner.set_state",
+        )
         # Under _auto_lock: a restore landing while the batcher thread is
         # inside _ensure_auto_compiled (a seconds-long AOT compile that
         # re-lays and writes back a PRE-restore state snapshot) would

@@ -535,6 +535,9 @@ def train(
             cooldown_s=control.cooldown_s,
             checkpoint_overhead_budget=control.checkpoint_overhead_budget,
             allow_recompile=control.allow_recompile,
+            recompile_cadence_s=getattr(
+                control, "recompile_cadence_s", 300.0
+            ),
         )
         control_loop.start()
 

@@ -40,9 +40,9 @@ for i in $(seq 1 60); do
     echo "$(date +%H:%M:%S) full bench rc=$rc json=$(head -c 200 /root/repo/BENCH_watch.json 2>/dev/null)" >> /tmp/tunnel_watch.log
     if grep -q '"backend": "tpu"' /root/repo/BENCH_watch.json 2>/dev/null; then
       if [ $rc -eq 0 ] && grep -q '"partial": false' /root/repo/BENCH_watch.json; then
-        cp /root/repo/BENCH_watch.json /root/repo/BENCH_live.json
-        git add -f BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels 2>/dev/null
-        git commit -m "bench: fresh full-section real-chip capture after tunnel recovery" -- BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels >> /tmp/tunnel_watch.log 2>&1
+        cp /root/repo/BENCH_watch.json /root/repo/docs/evidence/BENCH_live.json
+        git add -f docs/evidence/BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels 2>/dev/null
+        git commit -m "bench: fresh full-section real-chip capture after tunnel recovery" -- docs/evidence/BENCH_live.json BENCH_watch.json traces/bench traces/anakin_pixels >> /tmp/tunnel_watch.log 2>&1
         echo "$(date +%H:%M:%S) committed fresh full TPU bench" >> /tmp/tunnel_watch.log
         exit 0
       fi
