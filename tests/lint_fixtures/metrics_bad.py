@@ -18,6 +18,10 @@ reg.counter("control/orphan_series")  # subfamily-prefix (rule 3f)  # noqa: F821
 reg.gauge("control/decisions_made")  # subfamily-prefix (3f: prefix, not substring)  # noqa: F821
 reg.counter("serving/fleetsize")  # subfamily-prefix (3g: fleet_ prefix, not substring)  # noqa: F821
 reg.gauge("serving/routesplit")  # subfamily-prefix (3g: route_ prefix, not substring)  # noqa: F821
+reg.gauge("alerts/burning")  # subfamily-prefix (3h: burn_ prefix, not substring)  # noqa: F821
+reg.counter("alerts/orphan_series")  # subfamily-prefix (rule 3h)  # noqa: F821
+bad_agg = "telemetry/proc0wx/pool/step_ms"  # agg-prefix (malformed label)  # noqa: F821
+bad_agg2 = "telemetry/proc0w1/0bad/step"  # agg-prefix (bad remainder)  # noqa: F821
 rec.instant("Bad.Trace")  # trace-grammar  # noqa: F821
 rec.complete("serving/rogue_event", 0, 1)  # trace-closed-set  # noqa: F821
 rec.instant("serving/rollback")  # trace-closed-set (rollout is pinned, rollback is not)  # noqa: F821

@@ -21,7 +21,11 @@ reg.counter("serving/fleet_rollout_total")  # pinned sub-family (3g)  # noqa: F8
 reg.gauge("serving/fleet_active")  # pinned sub-family (3g)  # noqa: F821
 reg.counter("serving/route_retry_total")  # pinned sub-family (3g)  # noqa: F821
 reg.histogram("serving/route_latency_ms")  # pinned sub-family (3g)  # noqa: F821
+reg.gauge("alerts/firing_pool_step_p99")  # pinned sub-family (3h)  # noqa: F821
+reg.gauge("alerts/burn_rate_pool_step_p99")  # pinned sub-family (3h)  # noqa: F821
 key = "telemetry/pool/restarts"
+agg_key = "telemetry/proc0w1/pool/worker_step_ms_p50"  # aggregated form (3i)
+rec.instant("telemetry/alert", {"slo": "pool_step_p99"})  # trace name, not a metric key  # noqa: F821
 rec.instant("ring/commit", {"lid": "a0u0"})  # noqa: F821
 rec.complete("serving/request", 0, 1)  # pinned trace set  # noqa: F821
 rec.instant("serving/rollout", {"phase": "drain"})  # pinned trace set (3g additions)  # noqa: F821

@@ -401,6 +401,72 @@ def test_tracing_bench_overhead_bound(jax_cpu):
     assert out["overhead_pct"] < 10.0, out
 
 
+def test_export_bench_overhead_and_fanin_latency(jax_cpu):
+    """The ISSUE 17 acceptance bound, wired into CI via the export
+    section's tiny variant: serving the OpenMetrics endpoint under a
+    20 Hz scrape load must stay cheap, and the shared-memory fan-in
+    lane's publish->read roundtrip must be far under the 250 ms
+    worker publish interval it rides. The bench artifact pins <= 1%
+    overhead on a full box; the CI asserts keep slack for a loaded
+    1-core runner (the tiny arms divide two noisy throughputs)."""
+    from bench import run_bench_export
+
+    out = run_bench_export(jax_cpu, tiny=True)
+    # Raw exposition costs: render + scrape are sub-millisecond-class.
+    assert out["render_us"] < 50_000, out
+    assert out["scrape_us"] < 200_000, out
+    # Fan-in: a worker-sized payload (snapshot + 256-record trace
+    # tail) roundtrips in microseconds, not milliseconds — staleness
+    # is the 0.25 s publish interval, not the lane.
+    assert out["fanin_payload_bytes"] > 1_000, out
+    assert out["fanin_roundtrip_us"] < 100_000, out
+    # End-to-end: exporter + scraper overhead stays far below the
+    # point where --metrics-port would cost real throughput.
+    assert out["export_overhead_frac"] < 0.15, out
+
+
+def test_export_budgets_pinned_in_perfgate():
+    """The exposition-overhead ceiling is load-bearing: the full
+    bench's export records must be gated by perfgate's pinned
+    absolute budgets on every backend (empty fingerprint scope), and
+    a record violating a ceiling must produce a finding."""
+    from tools.perfgate import BUDGETS, check_records
+
+    assert BUDGETS["export_overhead_frac"] == {
+        "max": 0.01,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    }
+    assert BUDGETS["fanin_roundtrip_us"] == {
+        "max": 10_000.0,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    }
+
+    def rec(metric, value):
+        return {
+            "metric": metric,
+            "value": value,
+            "direction": "lower",
+            "fingerprint": "somebox|x86_64|cpu1",
+            "sha": "deadbeef",
+        }
+
+    good = [
+        rec("export_overhead_frac", 0.004),
+        rec("fanin_roundtrip_us", 800.0),
+    ]
+    assert check_records(good) == []
+    bad = [
+        rec("export_overhead_frac", 0.031),
+        rec("fanin_roundtrip_us", 25_000.0),
+    ]
+    findings = check_records(bad)
+    assert len(findings) == 2, findings
+    assert any("export_overhead_frac" in f for f in findings)
+    assert any("fanin_roundtrip_us" in f for f in findings)
+
+
 def test_loadgen_bench_fleet_beats_single_and_fails_over(jax_cpu):
     """The ISSUE 14 acceptance bounds, wired into CI via the bench
     loadgen section's tiny variant. Both arms serve int8 behind the

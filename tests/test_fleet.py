@@ -651,6 +651,53 @@ class TestPublishListeners:
 # ---- control plane: per-replica knob binding ---------------------------
 
 
+class TestFleetSloSpecs:
+    def test_objective_table_shape(self, agent, params):
+        """The fleet's SloSpec table (ISSUE 17) plugs into the
+        burn-rate engine: a dead replica held past the alert windows
+        must burn the active-floor budget, while a healthy fleet burns
+        nothing."""
+        from torched_impala_tpu.telemetry import AlertEngine
+        from torched_impala_tpu.telemetry.tracing import FlightRecorder
+
+        fleet, _ = make_fleet(agent, params)
+        try:
+            specs = fleet.slo_specs(slo_ms=40.0)
+            by_name = {s.name: s for s in specs}
+            assert by_name["fleet_route_p99"].key == (
+                "serving/route_latency_ms_p99"
+            )
+            assert by_name["fleet_route_p99"].objective == 40.0
+            floor = by_name["fleet_active_floor"]
+            assert floor.kind == "lower"
+            assert floor.is_bad(1.0)  # one of two replicas: degraded
+            assert not floor.is_bad(2.0)
+            reg = Registry()
+            eng = AlertEngine(
+                [
+                    type(floor)(
+                        **{
+                            **floor.__dict__,
+                            "fast_window_s": 0.5,
+                            "slow_window_s": 1.0,
+                        }
+                    )
+                ],
+                registry=reg,
+                recorder=FlightRecorder(capacity=16),
+            )
+            t, fired = 0.0, False
+            while t <= 2.0:
+                if eng.evaluate(
+                    {"telemetry/serving/fleet_active": 1.0}, now=t
+                ):
+                    fired = True
+                t += 0.1
+            assert fired
+        finally:
+            fleet.close()
+
+
 class TestFleetControl:
     def test_per_replica_knob_names(self, agent, params):
         fleet, _ = make_fleet(agent, params)

@@ -232,6 +232,7 @@ class TestMetricsChecker:
             "telemetry/type-fork",
             "telemetry/literal-key",
             "telemetry/subfamily-prefix",
+            "telemetry/agg-prefix",
             "telemetry/trace-grammar",
             "telemetry/trace-closed-set",
         }
@@ -256,6 +257,14 @@ class TestMetricsChecker:
         # "fleet"/"route"
         assert "serving/fleetsize" in msgs
         assert "serving/routesplit" in msgs
+        # 3h: alerts/* is a prefix match — alerts/burning fires even
+        # though it contains "burn"
+        assert "alerts metric" in msgs
+        assert "alerts/burning" in msgs
+        # 3i: aggregated proc<h>w<w>/ keys — malformed label and
+        # malformed remainder both fire
+        assert "proc0wx/pool/step_ms" in msgs
+        assert "proc0w1/0bad/step" in msgs
         # 4b closed set: serving/rollout is pinned, serving/rollback
         # is not
         assert "serving/rollback" in msgs
@@ -900,6 +909,7 @@ class TestShmCleanupUnderKill:
             max_restarts=4,
         )
         name = pool._shm.name
+        lane_name = pool._snap_lane._shm.name
         try:
             pool.reset_all()
             obs, rewards, dones, _ = pool.step_all(np.zeros(2, np.int32))
@@ -915,16 +925,21 @@ class TestShmCleanupUnderKill:
                     repaired = True
                     break
             assert repaired, "pool never repaired the killed worker"
-            # The segment is still attachable while the pool lives.
-            probe = shared_memory.SharedMemory(name=name)
-            probe.close()
+            # The segments are still attachable while the pool lives.
+            for seg in (name, lane_name):
+                probe = shared_memory.SharedMemory(name=seg)
+                probe.close()
         finally:
             pool.close()
-        # After close(): close + unlink ran on every exit path — the
-        # name must be GONE (this is what the static no-unlink rule
-        # guarantees; here we prove it held under a worker kill).
-        with pytest.raises(FileNotFoundError):
-            shared_memory.SharedMemory(name=name)
+        # After close(): close + unlink ran on every exit path — both
+        # names must be GONE (this is what the static no-unlink rule
+        # guarantees; here we prove it held under a worker kill). The
+        # ISSUE 17 snapshot lane rides the same lifecycle as the obs
+        # ring: a SIGKILLed publisher must not leak the fan-in segment
+        # either.
+        for seg in (name, lane_name):
+            with pytest.raises(FileNotFoundError):
+                shared_memory.SharedMemory(name=seg)
 
     def test_serving_ring_owner_unlinks_after_backpressure(self):
         """Same proof for the serving shm ring's RingBackpressure path:

@@ -1,0 +1,169 @@
+"""Terminal dashboard over the observability plane's exposition surface.
+
+Reads the OpenMetrics payload the run exports — either by scraping the
+pull endpoint (``--url http://host:port/metrics``, started with
+``--metrics-port``) or by tailing the atomic-write fallback file
+(``--file metrics.prom``, started with ``--metrics-file``) — and renders
+a grouped, refreshing text view:
+
+* one block per process label (``proc<h>w<w>`` worker rows from the
+  cross-process fan-in, plus the parent's own components),
+* an ALERTS header line showing every ``alerts/firing_*`` bit and its
+  companion burn rate, firing alerts highlighted,
+* headline gauges (steps/s counters are shown raw; rates are the SLO
+  engine's job, not the dashboard's).
+
+Stdlib only (urllib + ANSI escapes — no curses dependency), read-only,
+and safe to point at a live run: every refresh is one GET / one file
+read against a payload the exporter renders atomically.
+
+Usage::
+
+    python -m tools.dash --url http://127.0.0.1:9000/metrics
+    python -m tools.dash --file /tmp/run.prom --interval 2
+    python -m tools.dash --file /tmp/run.prom --once   # one shot, no ANSI
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Tuple
+
+from torched_impala_tpu.telemetry.export import parse_openmetrics
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD = "\x1b[1m"
+_RED = "\x1b[31m"
+_GREEN = "\x1b[32m"
+_DIM = "\x1b[2m"
+_RESET = "\x1b[0m"
+
+
+def fetch(url: str = "", path: str = "", timeout_s: float = 5.0) -> str:
+    """One exposition payload, from the endpoint or the fallback file."""
+    if url:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read().decode("utf-8")
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def group_metrics(
+    snap: Dict[str, float],
+) -> Tuple[Dict[str, Dict[str, float]], Dict[str, float]]:
+    """Split a parsed snapshot into per-process-label blocks plus the
+    alerts family. Keys here are the mangled OpenMetrics names
+    (``impala_proc0w1_pool_env_steps``), so worker rows are recognized
+    by the ``impala_proc<h>w<w>_`` head and everything else lands in
+    the parent block keyed ``"local"``."""
+    import re
+
+    label_re = re.compile(r"^impala_(proc\d+w\d+)_(.+)$")
+    groups: Dict[str, Dict[str, float]] = {}
+    alerts: Dict[str, float] = {}
+    for name, value in snap.items():
+        if name.startswith("impala_alerts_"):
+            alerts[name[len("impala_alerts_"):]] = value
+            continue
+        m = label_re.match(name)
+        if m:
+            groups.setdefault(m.group(1), {})[m.group(2)] = value
+        else:
+            short = name[len("impala_"):] if name.startswith(
+                "impala_"
+            ) else name
+            groups.setdefault("local", {})[short] = value
+    return groups, alerts
+
+
+def render(
+    snap: Dict[str, float], *, color: bool = True, width: int = 78
+) -> str:
+    """The full dashboard frame as one string (no ANSI when color is
+    off — the --once mode for piping into logs)."""
+
+    def c(code: str, s: str) -> str:
+        return f"{code}{s}{_RESET}" if color else s
+
+    groups, alerts = group_metrics(snap)
+    lines: List[str] = []
+    lines.append(c(_BOLD, "impala observability dash".ljust(width)))
+
+    # ALERTS header: firing_* bits with their burn_rate_* companions.
+    firing = {
+        k[len("firing_"):]: v
+        for k, v in alerts.items()
+        if k.startswith("firing_")
+    }
+    if firing:
+        parts = []
+        for name in sorted(firing):
+            burn = alerts.get(f"burn_rate_{name}", float("nan"))
+            mark = "FIRING" if firing[name] >= 1.0 else "ok"
+            text = f"{name}={mark} (burn {burn:.2f})"
+            parts.append(
+                c(_RED if firing[name] >= 1.0 else _GREEN, text)
+            )
+        lines.append("alerts: " + "  ".join(parts))
+    else:
+        lines.append(c(_DIM, "alerts: (no SLO engine attached)"))
+    lines.append("-" * width)
+
+    for label in sorted(groups, key=lambda s: (s != "local", s)):
+        block = groups[label]
+        title = "parent" if label == "local" else label
+        lines.append(c(_BOLD, f"[{title}]  ({len(block)} series)"))
+        for name in sorted(block):
+            v = block[name]
+            val = f"{v:.4g}" if v == v else "nan"
+            lines.append(f"  {name:<58} {val:>16}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--url", default="", help="metrics endpoint (…/metrics)"
+    )
+    src.add_argument(
+        "--file", default="", help="metrics fallback file (*.prom)"
+    )
+    p.add_argument(
+        "--interval", type=float, default=1.0, help="refresh seconds"
+    )
+    p.add_argument(
+        "--once",
+        action="store_true",
+        help="render one plain-text frame and exit (no ANSI)",
+    )
+    args = p.parse_args(argv)
+
+    while True:
+        try:
+            snap = parse_openmetrics(fetch(args.url, args.file))
+        except Exception as e:
+            frame = f"dash: fetch failed: {type(e).__name__}: {e}"
+            snap = None
+        if snap is not None:
+            frame = render(snap, color=not args.once)
+        try:
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write(_CLEAR + frame + "\n")
+            sys.stdout.flush()
+        except BrokenPipeError:
+            # `... | head` closed the pipe mid-frame; exit quietly.
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,15 +17,24 @@ Rules (rule ids in parentheses):
 3. literal emitted keys (``"telemetry/..."`` strings,
    ``f"{PREFIX}/..."`` interpolations) carry the same grammar
    (``telemetry/literal-key``);
-3b/3c/3d/3e/3f/3g. ``resilience/*``, ``serving/*`` (3g extends the set
-   with the fleet_/route_ sub-families), ``replay/*``, ``perf/*`` and
-   ``control/*`` names use their pinned sub-family prefixes
-   (``telemetry/subfamily-prefix``);
+3b/3c/3d/3e/3f/3g/3h. ``resilience/*``, ``serving/*`` (3g extends the
+   set with the fleet_/route_ sub-families), ``replay/*``, ``perf/*``,
+   ``control/*`` and (3h, the alerting plane) ``alerts/*`` names use
+   their pinned sub-family prefixes (``telemetry/subfamily-prefix``);
+3i. aggregated keys — literal keys whose first path segment is a
+   ``proc<h>w<w>`` process label (the cross-process fan-in re-prefix,
+   telemetry/aggregate.py) — carry a well-formed label AND a
+   grammar-clean remainder (``telemetry/agg-prefix``);
 4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
    ``.complete`` — follow the same slug grammar
    (``telemetry/trace-grammar``);
 4b. ``serving/*`` TRACE events are a closed set
    (``telemetry/trace-closed-set``).
+
+Rule 3 skips a quoted key that is the NAME argument of a trace call on
+the same line: trace events in the ``telemetry/`` component (the
+engine's ``telemetry/alert`` instants) are event names, not emitted
+metric keys, and rule 4 already validates them.
 
 Static on purpose: runs from tier-1 without initializing jax and sees
 dead call sites (a typo'd name in a rarely-taken branch still fails).
@@ -46,8 +55,11 @@ RULES = {
     "telemetry/type-fork": "one metric name registered as two types",
     "telemetry/literal-key": "literal emitted key violates the grammar",
     "telemetry/subfamily-prefix": (
-        "resilience/*, serving/*, replay/*, perf/* or control/* name "
-        "lacks its pinned sub-family prefix"
+        "resilience/*, serving/*, replay/*, perf/*, control/* or "
+        "alerts/* name lacks its pinned sub-family prefix"
+    ),
+    "telemetry/agg-prefix": (
+        "aggregated proc<h>w<w>/ key has a malformed label or remainder"
     ),
     "telemetry/trace-grammar": "trace event name violates the grammar",
     "telemetry/trace-closed-set": (
@@ -94,6 +106,15 @@ PERF_PREFIXES = ("mfu_", "membw_", "flops_", "gap_", "fused_", "h2d_")
 # `<sub>_` like rule 3e so the bare `control/decision` trace event
 # passes while control/decisions_made does not.
 CONTROL_PREFIXES = ("decision_", "revert_", "objective_", "knob_")
+# Rule 3h (SLO burn-rate alerting, ISSUE 17): the alerts/* family is
+# pinned to the engine's gauge shapes (telemetry/alerts.py) — firing
+# bits, burn rates, and room for slo/window configuration gauges.
+ALERTS_PREFIXES = ("burn_", "firing_", "slo_", "window_")
+# Rule 3i (cross-process fan-in, ISSUE 17): an aggregated key's first
+# segment is a proc<h>w<w> process label (telemetry/aggregate.py
+# LABEL_RE) and the rest must itself be a grammar-clean
+# <component>/<name> key.
+_AGG_LABEL = re.compile(r"^proc\d+w\d+$")
 SERVING_TRACE_EVENTS = {
     "serving/request", "serving/wave", "serving/shadow",
     # ISSUE 14 fleet instants: rollout lifecycle + replica failover.
@@ -194,6 +215,17 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         f"(rule 3f)",
                     )
                     continue
+                if name.startswith("alerts/") and not name.split(
+                    "/", 1
+                )[1].startswith(ALERTS_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"alerts metric {name!r} must use a "
+                        f"sub-family prefix {ALERTS_PREFIXES} "
+                        f"(rule 3h)",
+                    )
+                    continue
                 prev = seen.get(name)
                 if prev is None:
                     seen[name] = (kind, site)
@@ -224,22 +256,41 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         f"pinned set {sorted(SERVING_TRACE_EVENTS)} "
                         f"(rule 4b)",
                     )
+            # Trace-call NAMES on this line: a quoted "telemetry/..."
+            # that is the name argument of .instant/.begin/... is an
+            # event name (rule 4's job), not an emitted metric key.
+            trace_names = {n for _, _, n in _TRACE_CALL.findall(line)}
+
+            def _check_key(path: str, shown: str) -> None:
+                head, _, rest = path.partition("/")
+                if "/" in rest and head.startswith("proc"):
+                    # Aggregated-key shape (rule 3i): proc<h>w<w> label
+                    # + a grammar-clean re-prefixed key.
+                    if not (
+                        _AGG_LABEL.match(head) and NAME_RE.match(rest)
+                    ):
+                        out(
+                            "telemetry/agg-prefix",
+                            shown,
+                            f"aggregated key '{shown}' must be "
+                            f"proc<h>w<w>/<component>/<name> "
+                            f"(rule 3i)",
+                        )
+                    return
+                if not NAME_RE.match(path):
+                    out(
+                        "telemetry/literal-key",
+                        shown,
+                        f"literal key '{shown}' does not match "
+                        f"telemetry/<component>/<name>",
+                    )
+
             for m in _LITERAL_KEY.finditer(line):
-                if not NAME_RE.match(m.group(1)):
-                    out(
-                        "telemetry/literal-key",
-                        f"telemetry/{m.group(1)}",
-                        f"literal key 'telemetry/{m.group(1)}' does "
-                        f"not match telemetry/<component>/<name>",
-                    )
+                if f"telemetry/{m.group(1)}" in trace_names:
+                    continue
+                _check_key(m.group(1), f"telemetry/{m.group(1)}")
             for m in _PREFIX_KEY.finditer(line):
-                if not NAME_RE.match(m.group(1)):
-                    out(
-                        "telemetry/literal-key",
-                        f"PREFIX/{m.group(1)}",
-                        f"emitted key '{{PREFIX}}/{m.group(1)}' does "
-                        f"not match telemetry/<component>/<name>",
-                    )
+                _check_key(m.group(1), f"{{PREFIX}}/{m.group(1)}")
     return findings
 
 

@@ -274,6 +274,15 @@ class ExperimentConfig:
     # run.py's `--perf-report out.json` overrides per run, and SIGUSR2
     # also dumps a live report when enabled.
     perf_report: str = ""
+    # Observability plane exposition (telemetry/export.py): serve the
+    # run-wide AGGREGATED snapshot (local registry + proc<h>w<w>/
+    # worker fan-in) as an OpenMetrics endpoint on this TCP port
+    # (0 = off), and/or atomic-write it to this file path ("" = off;
+    # the sandboxed-run fallback). Either one also arms the SLO
+    # burn-rate alert engine (telemetry/alerts.py). run.py's
+    # `--metrics-port` / `--metrics-file` override per run.
+    metrics_port: int = 0
+    metrics_file: str = ""
     # Parallelism: shard the learner batch over this many devices (DP);
     # 0 = single device. SURVEY.md §3b DP row.
     dp_devices: int = 0

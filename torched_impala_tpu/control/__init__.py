@@ -26,6 +26,7 @@ from torched_impala_tpu.control.policies import (
     TargetMapPolicy,
 )
 from torched_impala_tpu.control.signals import (
+    AlertSignal,
     CheckpointOverheadSignal,
     EwmaSignal,
     FnSignal,
@@ -51,6 +52,7 @@ __all__ = [
     "Proposal",
     "SloPolicy",
     "TargetMapPolicy",
+    "AlertSignal",
     "CheckpointOverheadSignal",
     "EwmaSignal",
     "FnSignal",

@@ -111,6 +111,25 @@ class RateSignal(Signal):
         return (v - last_v) / (now - last_t)
 
 
+class AlertSignal(Signal):
+    """The SLO burn-rate engine's state (telemetry/alerts.py) as a
+    policy input: reads the ``alerts/firing_<name>`` gauge (0/1) the
+    engine maintains — or, with ``burn_rate=True``, the continuous
+    ``alerts/burn_rate_<name>`` gauge, which a proportional policy can
+    act on BEFORE the alert trips. The payoff of the alerting plane:
+    an SloPolicy bound to AlertSignal("serving_p99") scales/backs off
+    on exactly the condition that would page a human, with the same
+    multi-window hysteresis."""
+
+    def __init__(self, name: str, *, burn_rate: bool = False) -> None:
+        self.name = name
+        sub = "burn_rate_" if burn_rate else "firing_"
+        self.key = f"alerts/{sub}{name}"
+
+    def read(self, snap, now):
+        return _get(snap, self.key)
+
+
 class SloHeadroomSignal(Signal):
     """Normalized headroom of a latency percentile against an SLO
     budget: ``(budget - p99) / budget`` — positive means under budget,

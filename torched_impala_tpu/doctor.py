@@ -1294,6 +1294,136 @@ def _check_mixed_precision() -> tuple[str, str]:
         )
 
 
+def _obs_fanin_child(descriptor, slot: int, label: str) -> None:
+    """Child body for the observability fan-in probe: run the real
+    worker-side telemetry path (own registry + recorder, seqlock
+    publish through the shared-memory snapshot lane) exactly like an
+    env-pool worker does. Module-level so forkserver/spawn can pickle
+    it."""
+    import time as _time
+
+    from torched_impala_tpu.telemetry import WorkerTelemetry
+
+    wt = WorkerTelemetry(descriptor, slot, label)
+    try:
+        t0 = _time.monotonic_ns()
+        wt.record_step(t0, 1_000_000, "a0u0", 1)
+        wt.publish()
+    finally:
+        wt.close()
+
+
+def _check_observability() -> tuple[str, str]:
+    """Observability-plane self-check (docs/OBSERVABILITY.md, ISSUE 17):
+    (a) a 2-process fan-in roundtrip — two real child processes publish
+    worker telemetry through the shared-memory snapshot lane and the
+    aggregated snapshot must carry both proc<h>w<w>/ re-prefixed
+    blocks; (b) a seeded SLO breach must trip the burn-rate engine
+    within one slow window and set the alerts/firing_* gauge an
+    AlertSignal reads; (c) the merged multi-process trace export must
+    validate against the Chrome trace schema with per-process rows."""
+    import json
+    import tempfile
+
+    try:
+        from torched_impala_tpu.control import AlertSignal
+        from torched_impala_tpu.runtime.env_pool import _CTX
+        from torched_impala_tpu.telemetry import (
+            AlertEngine,
+            FlightRecorder,
+            Registry,
+            SloSpec,
+            SnapshotLane,
+            TelemetryAggregator,
+            export_merged_trace,
+            proc_label,
+        )
+        from torched_impala_tpu.telemetry.tracing import (
+            validate_chrome_trace,
+        )
+
+        # (a) 2-process fan-in roundtrip through the shm lane.
+        lane = SnapshotLane(2)
+        agg = TelemetryAggregator()
+        try:
+            labels = [proc_label(0, w) for w in range(2)]
+            for w, label in enumerate(labels):
+                agg.attach(label, lane, w)
+            procs = [
+                _CTX.Process(
+                    target=_obs_fanin_child,
+                    args=(lane.descriptor(), w, labels[w]),
+                )
+                for w in range(2)
+            ]
+            for p in procs:
+                p.start()
+            for p in procs:
+                p.join(timeout=60)
+                assert p.exitcode == 0, f"fan-in child rc={p.exitcode}"
+            local = Registry()
+            local.counter("doctor/parent_series").inc()
+            snap = agg.aggregated_snapshot(local.snapshot())
+            for label in labels:
+                key = f"telemetry/{label}/pool/env_steps"
+                assert key in snap, (key, sorted(snap)[:20])
+            assert "telemetry/doctor/parent_series" in snap
+            # Harvest (retire) each worker's last payload so the trace
+            # dumps survive the lane teardown, like pool.close() does.
+            for w, label in enumerate(labels):
+                agg.retire(label, lane.read(w))
+                agg.detach(label)
+            dumps = agg.trace_dumps()
+            assert len(dumps) == 2, len(dumps)
+        finally:
+            lane.close()
+
+        # (b) seeded SLO breach fires within one slow window.
+        reg = Registry()
+        spec = SloSpec(
+            name="doctor_probe",
+            key="doctor/probe_ms",
+            objective=10.0,
+            budget=0.1,
+            fast_window_s=1.0,
+            slow_window_s=5.0,
+        )
+        engine = AlertEngine([spec], registry=reg)
+        t = 100.0
+        fired_at = None
+        while t < 105.0 + 1e-9:  # one slow window of sustained breach
+            newly = engine.evaluate(
+                {"telemetry/doctor/probe_ms": 50.0}, now=t
+            )
+            if newly and fired_at is None:
+                fired_at = t - 100.0
+            t += 0.25
+        assert fired_at is not None, "breach never fired"
+        sig = AlertSignal("doctor_probe")
+        firing = sig.read(reg.snapshot(), t)
+        assert firing == 1.0, firing
+
+        # (c) merged trace export schema-validates with process rows.
+        rec = FlightRecorder(capacity=64)
+        rec.instant("doctor/parent_mark")
+        with tempfile.TemporaryDirectory() as td:
+            path = f"{td}/doctor_merged.json"
+            n = export_merged_trace(path, rec, agg)
+            with open(path) as f:
+                doc = json.load(f)
+            validate_chrome_trace(doc)
+            assert n > 0, "merged trace exported no events"
+        return "ok", (
+            f"2-proc fan-in ok ({len(dumps)} worker dumps), SLO breach "
+            f"fired after {fired_at:.2f}s (fast window 1s), merged "
+            f"trace schema-valid ({n} events)"
+        )
+    except Exception:
+        return "FAIL", (
+            f"observability plane broken:\n{traceback.format_exc()}"
+        )
+
+
 def run_doctor(config_name: str | None = None) -> int:
     print("== torched_impala_tpu doctor ==")
     print(f"python {sys.version.split()[0]}")
@@ -1371,6 +1501,9 @@ def run_doctor(config_name: str | None = None) -> int:
     failed |= status == "FAIL"
     status, detail = _check_mixed_precision()
     print(f"  mixed precision [{status}] {detail}")
+    failed |= status == "FAIL"
+    status, detail = _check_observability()
+    print(f"  observability [{status}] {detail}")
     failed |= status == "FAIL"
     for family in ("cartpole", "atari", "procgen", "dmlab"):
         status, detail = _check_env_contract(family)

@@ -284,6 +284,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "thread stacks + telemetry to stderr and emits a "
                         "telemetry/watchdog/stall event (default: "
                         "preset's stall_timeout_s, normally 300; 0 off)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve the run-wide AGGREGATED telemetry "
+                        "snapshot (local registry + proc<h>w<w>/ env-"
+                        "pool worker fan-in + alerts/* burn-rate "
+                        "gauges) as an OpenMetrics/Prometheus endpoint "
+                        "on http://localhost:PORT/metrics "
+                        "(telemetry/export.py; 0 = off; tools/dash.py "
+                        "renders a live dashboard over it)")
+    p.add_argument("--metrics-file", default=None, metavar="OUT.prom",
+                   help="atomic-write the same OpenMetrics payload to "
+                        "this file every exposition tick — the "
+                        "sandboxed-run fallback when no port can be "
+                        "bound (tools/dash.py --file reads it)")
     # Control plane (torched_impala_tpu/control/, docs/CONTROL.md).
     p.add_argument("--control", choices=("auto", "off"), default=None,
                    help="closed-loop control plane: 'auto' starts a "
@@ -334,6 +348,8 @@ def build_config(args: argparse.Namespace):
         ("train_dtype", "train_dtype"),
         ("trace", "trace_path"),
         ("perf_report", "perf_report"),
+        ("metrics_port", "metrics_port"),
+        ("metrics_file", "metrics_file"),
     ):
         v = getattr(args, flag)
         if v is not None:
@@ -698,6 +714,10 @@ def main(argv=None) -> int:
             trace_path=cfg.trace_path or None,
             perf_report_path=cfg.perf_report or None,
             control=cfg.control,
+            metrics_port=(
+                cfg.metrics_port if cfg.metrics_port > 0 else None
+            ),
+            metrics_file=cfg.metrics_file,
         )
     finally:
         if profile_window is not None:

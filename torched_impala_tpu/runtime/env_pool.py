@@ -76,9 +76,18 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-# Parent-side only: workers never record telemetry (their latency is
-# measured from the parent's submit->ack edge, so worker processes stay
-# numpy-only and never share metric locks across the fork).
+# Workers run their OWN lightweight Registry + FlightRecorder
+# (telemetry/aggregate.WorkerTelemetry — numpy/stdlib only, no metric
+# locks shared across the fork) and publish snapshots + trace tails
+# through a crash-tolerant seqlock shm lane; the parent aggregates them
+# under proc<h>w<w>/ prefixes. The parent still measures the
+# submit->ack edge itself — the two views bracket the pipe turnaround.
+from torched_impala_tpu.telemetry.aggregate import (
+    SnapshotLane,
+    WorkerTelemetry,
+    get_aggregator,
+    proc_label,
+)
 from torched_impala_tpu.telemetry.registry import Registry, get_registry
 from torched_impala_tpu.telemetry.tracing import (
     FlightRecorder,
@@ -126,6 +135,9 @@ def _worker_main(
     first_env_index: int,
     obs_shape: tuple,
     obs_dtype_str: str,
+    snapshot_descriptor: Optional[tuple] = None,
+    snapshot_slot: int = 0,
+    process_label: str = "",
 ) -> None:
     """Worker process body: build envs, then step on command.
 
@@ -142,6 +154,18 @@ def _worker_main(
     immune to that.
     """
     shm = shared_memory.SharedMemory(name=shm_name)
+    # Worker-side observability (telemetry/aggregate.py): an own
+    # registry + small flight recorder, published through the seqlock
+    # snapshot lane. Best-effort by construction — a telemetry failure
+    # must never take an env worker down.
+    wt: Optional[WorkerTelemetry] = None
+    if snapshot_descriptor is not None:
+        try:
+            wt = WorkerTelemetry(
+                snapshot_descriptor, snapshot_slot, process_label
+            )
+        except Exception:
+            wt = None
     try:
         obs_dtype = np.dtype(obs_dtype_str)
         nbytes = num_envs * int(np.prod(obs_shape)) * obs_dtype.itemsize
@@ -187,6 +211,8 @@ def _worker_main(
 
         reset_envs()
         conn.send(("ready", task_ids))
+        if wt is not None:
+            wt.publish()  # fan-in visible from the first parent read
 
         while True:
             msg = conn.recv()
@@ -201,6 +227,12 @@ def _worker_main(
                 conn.send(("reset_done",))
                 continue
             assert msg[0] == "step", msg
+            # The step token carries the lineage ID of the unroll the
+            # parent is filling, so this worker's own stepping span
+            # nests under the parent's submit->ack span in the merged
+            # trace.
+            lid = msg[1] if len(msg) > 1 else ""
+            t0_ns = time.monotonic_ns()
             events: List[Tuple[int, float, int]] = []
             for i, env in enumerate(envs):
                 obs, reward, terminated, truncated, _ = env.step(
@@ -219,7 +251,16 @@ def _worker_main(
                     ep_len[i] = 0
                     obs, _ = env.reset()
                 obs_block[i] = np.asarray(obs)
+            if wt is not None:
+                wt.record_step(
+                    t0_ns,
+                    time.monotonic_ns() - t0_ns,
+                    lid,
+                    len(events),
+                )
             conn.send(("stepped", events))
+            if wt is not None:
+                wt.maybe_publish()  # after the ack: off the latency path
     except EOFError:
         pass
     except BaseException as e:  # noqa: BLE001 — must report, then die
@@ -228,6 +269,8 @@ def _worker_main(
         except Exception:
             pass
     finally:
+        if wt is not None:
+            wt.close()  # final publish: the exit-path trace dump
         shm.close()
 
 
@@ -265,6 +308,8 @@ class ProcessEnvPool:
         ready_fraction: float = 0.5,
         telemetry: Optional[Registry] = None,
         tracer: Optional[FlightRecorder] = None,
+        label_host: int = 0,
+        aggregator=None,
     ) -> None:
         if num_workers < 1 or envs_per_worker < 1:
             raise ValueError("need >= 1 worker and >= 1 env per worker")
@@ -417,6 +462,22 @@ class ProcessEnvPool:
         self._in_flight: set = set()  # workers with an unacked step token
         self.task_ids: List[int] = [0] * n
         self._closed = False
+        # Cross-process fan-in (telemetry/aggregate.py): one seqlock
+        # snapshot slot per worker, registered with the process-global
+        # aggregator under proc<h>w<w>/ labels. Worker indices derive
+        # from first_env_index so the labels of a run's multiple pools
+        # never collide (loop.py splits actors across pool groups).
+        first_worker = first_env_index // envs_per_worker
+        self._labels = [
+            proc_label(label_host, first_worker + w)
+            for w in range(num_workers)
+        ]
+        self._snap_lane = SnapshotLane(num_workers)
+        self._aggregator = (
+            aggregator if aggregator is not None else get_aggregator()
+        )
+        for w, label in enumerate(self._labels):
+            self._aggregator.attach(label, self._snap_lane, w)
         try:
             # Start every worker before waiting on any. Under forkserver a
             # start is a ~ms fork; under the spawn fallback interpreter
@@ -464,6 +525,9 @@ class ProcessEnvPool:
                 self._first_env_index + w * E,
                 self._obs_shape,
                 self._obs_dtype.str,
+                self._snap_lane.descriptor(),
+                w,
+                self._labels[w],
             ),
             daemon=True,
         )
@@ -578,6 +642,14 @@ class ProcessEnvPool:
         if proc is not None:
             proc.join(timeout=10)
         self._conns[w].close()
+        # Harvest the dead worker's last consistent snapshot (its trace
+        # tail must survive for the merged export), then clear the slot
+        # so the stale pid/series never outlive the repair — the
+        # respawned worker republishes with its own pid.
+        self._aggregator.retire(
+            self._labels[w], self._snap_lane.read(w)
+        )
+        self._snap_lane.clear(w)
         self._spawn(w)
 
     # -- batched env surface ----------------------------------------------
@@ -670,7 +742,7 @@ class ProcessEnvPool:
         for w in range(self._num_workers):
             try:
                 self._submit_t[w] = time.monotonic()
-                self._conns[w].send(("step",))
+                self._conns[w].send(("step", self.trace_lineage))
             except (BrokenPipeError, OSError) as e:
                 self._restart(w, f"send failed: {e!r}")
                 dead.append(w)
@@ -727,7 +799,7 @@ class ProcessEnvPool:
         self._act_lane[sl] = np.asarray(actions, np.int32)
         try:
             self._submit_t[w] = time.monotonic()
-            self._conns[w].send(("step",))
+            self._conns[w].send(("step", self.trace_lineage))
         except (BrokenPipeError, OSError) as e:
             self._restart(w, f"send failed: {e!r}")
             return False
@@ -848,6 +920,17 @@ class ProcessEnvPool:
                     conn.close()
                 except Exception:
                     pass
+        # Harvest every worker's final published payload (their exit
+        # paths publish the full trace ring) into the aggregator's
+        # retired set, then detach the labels and unlink the snapshot
+        # lane — after close() neither shm segment survives.
+        for w, label in enumerate(self._labels):
+            try:
+                self._aggregator.retire(label, self._snap_lane.read(w))
+            except Exception:
+                pass
+            self._aggregator.detach(label)
+        self._snap_lane.close()
         # Views into the segment must drop before close() or the buffer
         # export keeps the mapping alive (BufferError on some platforms).
         del self._obs_block, self._act_lane, self._rew_lane, self._done_lane

@@ -24,7 +24,12 @@ from torched_impala_tpu.runtime.learner import Learner, LearnerConfig
 from torched_impala_tpu.runtime.supervisor import ActorSupervisor
 from torched_impala_tpu.runtime.vector_actor import VectorActor
 from torched_impala_tpu.telemetry import (
+    AlertEngine,
+    MetricsExporter,
     StallWatchdog,
+    default_slo_specs,
+    export_merged_trace,
+    get_aggregator,
     get_recorder,
     get_registry,
     install_thread_excepthook,
@@ -71,6 +76,9 @@ def train(
     trace_path: Optional[str] = None,
     perf_report_path: Optional[str] = None,
     control=None,
+    metrics_port: Optional[int] = None,
+    metrics_file: str = "",
+    slo_specs=None,
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -156,6 +164,15 @@ def train(
       Chrome-trace JSON when the run ends — crash- and stop-safe via
       the same finally that tears the pipeline down. Load it in
       Perfetto (docs/OBSERVABILITY.md).
+    - `metrics_port` (TCP port, None = off, 0 = ephemeral) serves the
+      run-wide AGGREGATED snapshot — local registry + every env-pool
+      worker's fan-in under `proc<h>w<w>/` prefixes — as an
+      OpenMetrics/Prometheus text endpoint (telemetry/export.py);
+      `metrics_file` atomic-writes the same payload for sandboxed runs.
+      Either one also arms the SLO burn-rate alert engine
+      (telemetry/alerts.py; `slo_specs` overrides the default table),
+      whose `alerts/*` gauges ride the same snapshot and whose state
+      control policies can consume via `control.AlertSignal`.
     - `perf_report_path="out.json"` runs the performance observatory
       (perf/report.py) over the same retained events at run end:
       inter-train_step gap attribution (feed/H2D/publish/compile/
@@ -386,6 +403,10 @@ def train(
                         ),
                         mode=pool_mode,
                         ready_fraction=pool_ready_fraction,
+                        # proc<h>w<w> fan-in labels: h = this host's
+                        # controller index, w = global worker slot (the
+                        # pool derives it from first_env_index).
+                        label_host=jax.process_index(),
                     )
                 )
         except BaseException:
@@ -541,6 +562,36 @@ def train(
         )
         control_loop.start()
 
+    # Observability plane (docs/OBSERVABILITY.md): the aggregator folds
+    # every env-pool worker's published snapshot into the run-wide view;
+    # the exporter serves/writes it as OpenMetrics and ticks the SLO
+    # burn-rate alert engine on a steady cadence.
+    aggregator = get_aggregator()
+
+    def aggregated_snapshot() -> dict:
+        return aggregator.aggregated_snapshot(registry.snapshot())
+
+    alert_engine = None
+    metrics_exporter = None
+    if metrics_port is not None or metrics_file:
+        alert_engine = AlertEngine(
+            default_slo_specs() if slo_specs is None else slo_specs,
+            registry,
+        )
+        metrics_exporter = MetricsExporter(
+            aggregated_snapshot,
+            port=metrics_port,
+            path=metrics_file or "",
+            alert_engine=alert_engine,
+        ).start()
+        if metrics_port is not None:
+            print(
+                f"[metrics] OpenMetrics endpoint on "
+                f"http://localhost:{metrics_exporter.port}/metrics",
+                file=sys.stderr,
+                flush=True,
+            )
+
     stall_watchdog: Optional[StallWatchdog] = None
     if stall_timeout > 0:
 
@@ -553,7 +604,11 @@ def train(
                     logger(dict(event))
 
         stall_watchdog = StallWatchdog(
-            registry, deadline_s=stall_timeout, on_stall=_on_stall
+            registry,
+            deadline_s=stall_timeout,
+            on_stall=_on_stall,
+            aggregator=aggregator,
+            alert_engine=alert_engine,
         ).start()
 
     try:
@@ -563,22 +618,10 @@ def train(
             control_loop.stop()
         if stall_watchdog is not None:
             stall_watchdog.stop()
+        if metrics_exporter is not None:
+            metrics_exporter.stop()
         stop_event.set()
         learner.stop()
-        if trace_path:
-            try:
-                n = get_recorder().export(trace_path)
-                print(
-                    f"[flight-recorder] {n} events -> {trace_path}",
-                    file=sys.stderr,
-                    flush=True,
-                )
-            except Exception as e:  # noqa: BLE001 — teardown must finish
-                print(
-                    f"[flight-recorder] export failed: {e!r}",
-                    file=sys.stderr,
-                    flush=True,
-                )
         if perf_report_path:
             try:
                 from torched_impala_tpu.perf import generate_report
@@ -609,6 +652,29 @@ def train(
         supervisor.join()
         for pool in env_pools:
             pool.close()
+        # Merged trace export runs AFTER pool close: closing a pool
+        # harvests every worker's final published payload (their exit
+        # paths dump the full trace ring through the snapshot lane), so
+        # the timeline gets one row per worker process with
+        # pool/worker_step spans nested under the parent's submit->ack
+        # spans by lineage ID.
+        if trace_path:
+            try:
+                n = export_merged_trace(
+                    trace_path, get_recorder(), aggregator
+                )
+                print(
+                    f"[flight-recorder] {n} events (merged) -> "
+                    f"{trace_path}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                print(
+                    f"[flight-recorder] export failed: {e!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
 
     # Final saves land only on a CLEAN finish — an exception above (a real
     # crash or a chaos crash_learner fault) propagates past this point, so

@@ -200,6 +200,31 @@ class ServingFleet:
         )
         self._listener = store.add_publish_listener(self._on_publish)
 
+    def slo_specs(self, slo_ms: float = 50.0) -> list:
+        """The fleet's objective table for the burn-rate alert engine
+        (telemetry/alerts.py): routed-request latency against the
+        serving SLO, and an active-replica floor — a replica dead or
+        draining beyond the alert windows is a standing capacity loss,
+        unlike the transient dips rollout()/failover cause. Feed these
+        to `AlertEngine` alongside `default_slo_specs()`."""
+        from torched_impala_tpu.telemetry.alerts import SloSpec
+
+        return [
+            SloSpec(
+                name="fleet_route_p99",
+                key="serving/route_latency_ms_p99",
+                objective=float(slo_ms),
+                budget=0.05,
+            ),
+            SloSpec(
+                name="fleet_active_floor",
+                key="serving/fleet_active",
+                objective=len(self._replicas) - 0.5,
+                kind="lower",
+                budget=0.1,
+            ),
+        ]
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ServingFleet":

@@ -40,6 +40,29 @@ from torched_impala_tpu.telemetry.tracing import (
     set_trace_enabled,
     validate_chrome_trace,
 )
+from torched_impala_tpu.telemetry.aggregate import (
+    LABEL_RE,
+    SnapshotLane,
+    SnapshotWriter,
+    TelemetryAggregator,
+    WorkerTelemetry,
+    export_merged_trace,
+    get_aggregator,
+    merge_chrome_events,
+    proc_label,
+)
+from torched_impala_tpu.telemetry.alerts import (
+    AlertEngine,
+    SloSpec,
+    default_slo_specs,
+)
+from torched_impala_tpu.telemetry.export import (
+    MetricsExporter,
+    metric_name,
+    parse_openmetrics,
+    to_openmetrics,
+    write_metrics_file,
+)
 
 __all__ = [
     "DEFAULT_MS_BUCKETS",
@@ -65,4 +88,21 @@ __all__ = [
     "mint_lineage_id",
     "set_trace_enabled",
     "validate_chrome_trace",
+    "LABEL_RE",
+    "SnapshotLane",
+    "SnapshotWriter",
+    "TelemetryAggregator",
+    "WorkerTelemetry",
+    "export_merged_trace",
+    "get_aggregator",
+    "merge_chrome_events",
+    "proc_label",
+    "AlertEngine",
+    "SloSpec",
+    "default_slo_specs",
+    "MetricsExporter",
+    "metric_name",
+    "parse_openmetrics",
+    "to_openmetrics",
+    "write_metrics_file",
 ]

@@ -1,0 +1,517 @@
+"""Cross-process telemetry fan-in: worker registries -> one parent view.
+
+Every observability layer so far (registry, flight recorder, perf
+observatory) is process-local, but the system it watches is not:
+ProcessEnvPool workers are separate processes whose internals were only
+inferred from the parent's submit->ack edge, and the ROADMAP's
+multi-host tentpole (Podracer, arxiv 2104.06272) adds whole peer hosts.
+This module gives every worker its own lightweight Registry + small
+FlightRecorder and a crash-tolerant shared-memory lane to publish both
+through, so the parent's aggregated snapshot covers the whole run:
+
+  worker process                       parent process
+  Registry --+                         SnapshotLane.read(slot)
+  Recorder --+-> payload (JSON) ------>   -> last-good payload
+             SnapshotWriter.publish()   TelemetryAggregator
+             (seqlock slot in shm)        -> telemetry/proc<h>w<w>/...
+
+Lane protocol — the env_pool/shm_ring lane idiom adapted to snapshots:
+one SharedMemory segment, one fixed-size slot per worker, SPSC per
+slot. Each slot is a *seqlock*: the writer bumps the sequence counter
+to ODD, writes pid + length + payload, then bumps it to EVEN — the
+even store is the publish edge (written LAST, like the shm ring's
+status byte). The reader copies under a seq/re-check pair and discards
+torn reads. A worker SIGKILLed mid-publish leaves the slot's seq odd
+forever; the parent simply keeps the last good payload — worker death
+can never corrupt or wedge the parent aggregate.
+
+Aggregated keys re-prefix each worker's snapshot under its process
+label: a worker key telemetry/pool/worker_step_ms_p50 becomes
+telemetry/proc0w1/pool/worker_step_ms_p50 in the parent view
+(`proc<h>w<w>` = host index h, global worker index w; impala-lint
+validates the prefix grammar). The same payloads carry each worker's
+flight-recorder tail stamped with (pid, process label), so
+`export_merged_trace` emits ONE Perfetto timeline with per-process
+rows — a worker's pool/worker_step span nests under the parent's
+submit->ack span via the shared lineage IDs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from torched_impala_tpu.telemetry.registry import (
+    PREFIX,
+    Registry,
+    get_registry,
+)
+from torched_impala_tpu.telemetry.tracing import (
+    FlightRecorder,
+    get_recorder,
+)
+
+# Process labels: proc<host>w<worker>, both decimal. The single source
+# of truth for the aggregation prefix grammar (impala-lint's agg-prefix
+# rule enforces the same shape on literal keys).
+LABEL_RE = re.compile(r"^proc\d+w\d+$")
+
+# Per-slot header: seq (u64), payload length (u32), writer pid (u32).
+_HEADER = struct.Struct("<QII")
+DEFAULT_SLOT_BYTES = 1 << 17  # 128 KiB: snapshot + a ~512-record trace
+# Retired payloads kept per label (restart dumps): enough for every
+# realistic repair sequence without unbounded growth on a crash loop.
+_MAX_RETIRED = 8
+
+
+def proc_label(host: int, worker: int) -> str:
+    """`proc<h>w<w>` — host index h (jax.process_index on multi-host,
+    0 single-host), global worker index w."""
+    return f"proc{int(host)}w{int(worker)}"
+
+
+class SnapshotLane:
+    """Owner (parent) side of the fan-in lane: one shm segment holding
+    `num_slots` seqlock slots of `slot_bytes` each. The parent creates
+    and unlinks the segment; workers attach via `descriptor()` ->
+    `SnapshotWriter`. `read(slot)` returns the newest *consistent*
+    payload (dict) or None — torn/in-progress publishes fall back to
+    the previous good payload, so a writer dying mid-publish is
+    invisible to readers."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        slot_bytes: int = DEFAULT_SLOT_BYTES,
+        shm_name: Optional[str] = None,
+    ):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if slot_bytes <= _HEADER.size + 2:
+            raise ValueError(f"slot_bytes too small: {slot_bytes}")
+        self.num_slots = num_slots
+        self.slot_bytes = slot_bytes
+        self._owner = shm_name is None
+        if self._owner:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=num_slots * slot_bytes
+            )
+        else:
+            self._shm = shared_memory.SharedMemory(name=shm_name)
+        self._lock = threading.Lock()
+        # slot -> (seq, payload) of the last consistent read
+        self._last_good: Dict[int, Tuple[int, dict]] = {}
+        self._closed = False
+
+    # -- layout ----------------------------------------------------------
+
+    def _off(self, slot: int) -> int:
+        if not 0 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range")
+        return slot * self.slot_bytes
+
+    def descriptor(self) -> Tuple[str, int, int]:
+        """Picklable attach handle for `SnapshotWriter` (crosses the
+        worker-process boundary in the spawn args)."""
+        return (self._shm.name, self.num_slots, self.slot_bytes)
+
+    # -- parent-side read -------------------------------------------------
+
+    def read(self, slot: int) -> Optional[dict]:
+        """The newest consistent payload for `slot`, or None before the
+        first publish. Seqlock read: copy under a seq sample/re-check
+        pair; a torn copy (writer mid-publish or dead mid-publish)
+        falls back to the cached last-good payload."""
+        off = self._off(slot)
+        buf = self._shm.buf
+        with self._lock:
+            if self._closed:
+                return None
+            seq1, length, pid = _HEADER.unpack_from(buf, off)
+            last = self._last_good.get(slot)
+            if seq1 == 0 or seq1 & 1:
+                # Never published, or a publish is in flight (possibly
+                # forever: SIGKILL mid-write). Keep the last good value.
+                return last[1] if last else None
+            if last is not None and last[0] == seq1:
+                return last[1]
+            if length > self.slot_bytes - _HEADER.size:
+                return last[1] if last else None
+            body = bytes(
+                buf[off + _HEADER.size : off + _HEADER.size + length]
+            )
+            seq2, _, _ = _HEADER.unpack_from(buf, off)
+            if seq2 != seq1:
+                return last[1] if last else None  # torn: writer raced us
+            try:
+                payload = json.loads(body.decode("utf-8"))
+            except Exception:
+                return last[1] if last else None
+            payload["pid"] = pid
+            self._last_good[slot] = (seq1, payload)
+            return payload
+
+    def clear(self, slot: int) -> None:
+        """Forget `slot` entirely — header zeroed AND the last-good
+        cache dropped. Called by the pool on worker restart so a dead
+        worker's pid/series never outlive its repair."""
+        off = self._off(slot)
+        with self._lock:
+            if self._closed:
+                return
+            _HEADER.pack_into(self._shm.buf, off, 0, 0, 0)
+            self._last_good.pop(slot, None)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._last_good.clear()
+        self._shm.close()
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class SnapshotWriter:
+    """Worker side: attach to the lane by descriptor and own ONE slot.
+    `publish(payload)` is the seqlock write — seq to odd, body, seq to
+    even (the publish edge, written LAST). Close() detaches (attach
+    side never unlinks)."""
+
+    def __init__(self, descriptor: Tuple[str, int, int], slot: int):
+        name, num_slots, slot_bytes = descriptor
+        self.slot_bytes = slot_bytes
+        if not 0 <= slot < num_slots:
+            raise IndexError(f"slot {slot} out of range")
+        self._off = slot * slot_bytes
+        self._shm = shared_memory.SharedMemory(name=name)
+        self._seq = 0
+        self._closed = False
+
+    @property
+    def capacity(self) -> int:
+        return self.slot_bytes - _HEADER.size
+
+    def publish(self, payload: Mapping) -> bool:
+        """Serialize and publish one payload; returns False when it
+        exceeds the slot capacity (caller shrinks and retries)."""
+        if self._closed:
+            return False
+        body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        if len(body) > self.capacity:
+            return False
+        buf = self._shm.buf
+        pid = os.getpid()
+        # Odd seq marks the publish in progress; a crash between the
+        # two header stores leaves it odd forever, which readers treat
+        # as "keep the last good payload".
+        self._seq += 1
+        _HEADER.pack_into(buf, self._off, self._seq, len(body), pid)
+        buf[
+            self._off + _HEADER.size : self._off + _HEADER.size + len(body)
+        ] = body
+        self._seq += 1
+        _HEADER.pack_into(buf, self._off, self._seq, len(body), pid)
+        return True
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._shm.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class WorkerTelemetry:
+    """Everything an env-pool worker process runs observability-wise: a
+    fresh (never-forked) Registry, a small FlightRecorder stamped with
+    the worker's process label, and the lane writer. Deliberately
+    numpy/stdlib-only — worker processes never touch jax.
+
+    `record_step` is the worker-side mirror of the parent's
+    submit->ack edge: the actual env-stepping span, recorded as
+    pool/worker_step with the unroll's lineage ID so the merged trace
+    nests it under the parent span that waited on it."""
+
+    PUBLISH_INTERVAL_S = 0.25
+    TRACE_TAIL = 512
+
+    def __init__(
+        self,
+        descriptor: Tuple[str, int, int],
+        slot: int,
+        label: str,
+    ):
+        self.label = label
+        self.registry = Registry()
+        self.recorder = FlightRecorder(
+            capacity=2048, process_label=label
+        )
+        self._writer = SnapshotWriter(descriptor, slot)
+        self._m_step_ms = self.registry.histogram("pool/worker_step_ms")
+        self._m_steps = self.registry.counter("pool/env_steps")
+        self._m_events = self.registry.counter("pool/episode_events")
+        self._last_publish = 0.0
+
+    def record_step(
+        self, t0_ns: int, dur_ns: int, lid: str, n_events: int
+    ) -> None:
+        self._m_step_ms.observe(dur_ns / 1e6)
+        self._m_steps.inc()
+        if n_events:
+            self._m_events.inc(n_events)
+        self.recorder.complete(
+            "pool/worker_step", t0_ns, dur_ns, {"lid": lid}
+        )
+
+    def payload(self, trace_tail: Optional[int] = None) -> dict:
+        tail = self.TRACE_TAIL if trace_tail is None else trace_tail
+        return {
+            "label": self.label,
+            "pid": os.getpid(),
+            "snapshot": self.registry.snapshot(drop_nan=True),
+            "heartbeats": self.registry.heartbeats(),
+            "trace": self.recorder.tail(tail),
+            "thread_names": {
+                str(k): v
+                for k, v in self.recorder._thread_names.items()
+            },
+        }
+
+    def publish(self) -> None:
+        """One seqlock publish; when the trace tail overflows the slot,
+        retry with a shrinking tail (metrics always make it out)."""
+        self.registry.heartbeat(self.label)
+        tail = self.TRACE_TAIL
+        while not self._writer.publish(self.payload(tail)):
+            if tail == 0:
+                return  # snapshot alone exceeds the slot: drop this one
+            tail //= 4
+        self._last_publish = time.monotonic()
+
+    def maybe_publish(self) -> None:
+        if time.monotonic() - self._last_publish >= self.PUBLISH_INTERVAL_S:
+            self.publish()
+
+    def close(self) -> None:
+        """Final publish (the exit-path trace dump) then detach."""
+        try:
+            self.publish()
+        except Exception:
+            pass
+        self._writer.close()
+
+
+class TelemetryAggregator:
+    """Parent-side fan-in: live lanes keyed by process label, plus the
+    retired payloads harvested when a worker restarts or a pool closes
+    (their trace dumps must outlive the worker for the merged export).
+
+    `aggregated_snapshot` = the local registry snapshot + every live
+    worker's last-good snapshot re-keyed under telemetry/<label>/...
+    Reads never block on a worker: a dead/mid-publish writer just
+    contributes its previous payload (or nothing)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Tuple[SnapshotLane, int]] = {}
+        self._retired: Dict[str, List[dict]] = {}
+
+    # -- registration ----------------------------------------------------
+
+    def attach(self, label: str, lane: SnapshotLane, slot: int) -> None:
+        if not LABEL_RE.match(label):
+            raise ValueError(
+                f"process label {label!r} must match {LABEL_RE.pattern}"
+            )
+        with self._lock:
+            self._sources[label] = (lane, slot)
+
+    def detach(self, label: str) -> None:
+        with self._lock:
+            self._sources.pop(label, None)
+
+    def retire(self, label: str, payload: Optional[dict]) -> None:
+        """Keep a worker's final payload (restart/close harvest) for
+        the merged trace; bounded per label so a crash loop cannot grow
+        the parent without bound."""
+        if not payload:
+            return
+        with self._lock:
+            dumps = self._retired.setdefault(label, [])
+            dumps.append(payload)
+            del dumps[:-_MAX_RETIRED]
+
+    def labels(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sources)
+
+    def reset(self) -> None:
+        """Drop every source and retired dump (tests / run teardown)."""
+        with self._lock:
+            self._sources.clear()
+            self._retired.clear()
+
+    # -- reads -----------------------------------------------------------
+
+    def _live_payloads(self) -> List[Tuple[str, dict]]:
+        with self._lock:
+            sources = list(self._sources.items())
+        out = []
+        for label, (lane, slot) in sources:
+            payload = lane.read(slot)
+            if payload:
+                out.append((label, payload))
+        return out
+
+    def worker_pids(self) -> Dict[str, int]:
+        """label -> pid of the last-published (live) worker — the
+        stale-pid regression surface: after a repair the old pid must
+        not appear here."""
+        return {
+            label: int(payload.get("pid", 0))
+            for label, payload in self._live_payloads()
+        }
+
+    def aggregated_snapshot(
+        self, local: Optional[Mapping[str, float]] = None
+    ) -> Dict[str, float]:
+        out: Dict[str, float] = dict(
+            get_registry().snapshot() if local is None else local
+        )
+        for label, payload in self._live_payloads():
+            snap = payload.get("snapshot") or {}
+            for key, value in snap.items():
+                # telemetry/<component>/<name> -> re-prefix under the
+                # worker's process label.
+                _, _, rest = key.partition("/")
+                if rest:
+                    out[f"{PREFIX}/{label}/{rest}"] = value
+        return out
+
+    def trace_dumps(self) -> List[dict]:
+        """Every payload carrying trace records: live last-good first,
+        then retired (restart/close) dumps — the merged exporter's
+        input."""
+        dumps = [p for _, p in self._live_payloads()]
+        with self._lock:
+            for label in sorted(self._retired):
+                dumps.extend(self._retired[label])
+        return [d for d in dumps if d.get("trace")]
+
+
+# -- merged trace export ----------------------------------------------------
+
+# Worker process rows start here so they never collide with the
+# parent's per-component synthetic pids (1..N_components).
+_WORKER_PID_BASE = 1000
+
+
+def merge_chrome_events(
+    recorder: FlightRecorder, dumps: List[dict]
+) -> List[dict]:
+    """ONE Chrome-trace event list with per-process rows: the parent's
+    component rows (recorder.to_chrome_events, unchanged) plus one
+    process row per worker dump, named by its (label, pid) stamp.
+    monotonic_ns is machine-wide on Linux, so worker spans land at
+    their true offsets — a worker's pool/worker_step sits inside the
+    parent's submit->ack span for the same lineage ID."""
+    events = recorder.to_chrome_events()
+    seen: Dict[Tuple[str, int], int] = {}  # (label, pid) -> trace pid
+    for dump in dumps:
+        label = str(dump.get("label", "proc?"))
+        pid = int(dump.get("pid", 0))
+        key = (label, pid)
+        if key not in seen:
+            seen[key] = _WORKER_PID_BASE + len(seen)
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": seen[key],
+                    "tid": 0,
+                    "args": {"name": f"{label} (pid {pid})"},
+                }
+            )
+        tpid = seen[key]
+        thread_names = dump.get("thread_names") or {}
+        named_tids = set()
+        for rec in dump.get("trace") or []:
+            ts_ns, dur_ns, phase, name, tid, lineage = rec
+            ev = {
+                "name": name,
+                "cat": name.split("/", 1)[0],
+                "ph": phase,
+                "ts": ts_ns / 1e3,
+                "pid": tpid,
+                "tid": tid,
+            }
+            if phase == "X":
+                ev["dur"] = dur_ns / 1e3
+            elif phase == "i":
+                ev["s"] = "t"
+            if lineage:
+                ev["args"] = dict(lineage)
+            events.append(ev)
+            tname = thread_names.get(str(tid))
+            if tname and tid not in named_tids:
+                named_tids.add(tid)
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": tpid,
+                        "tid": tid,
+                        "args": {"name": tname},
+                    }
+                )
+    return events
+
+
+def export_merged_trace(
+    path: str,
+    recorder: Optional[FlightRecorder] = None,
+    aggregator: Optional["TelemetryAggregator"] = None,
+) -> int:
+    """Write the merged (parent + every worker dump) timeline as
+    Chrome-trace JSON; returns the number of non-metadata events.
+    Replaces the parent-only `recorder.export` at run teardown — same
+    schema (telemetry.validate_chrome_trace), more rows."""
+    rec = recorder if recorder is not None else get_recorder()
+    agg = aggregator if aggregator is not None else get_aggregator()
+    events = merge_chrome_events(rec, agg.trace_dumps())
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return sum(1 for e in events if e["ph"] != "M")
+
+
+_GLOBAL = TelemetryAggregator()
+
+
+def get_aggregator() -> TelemetryAggregator:
+    """The process-global aggregator every pool/peer lane attaches to
+    (mirrors registry.get_registry / tracing.get_recorder)."""
+    return _GLOBAL

@@ -1,0 +1,241 @@
+"""Metrics exposition: the aggregated snapshot as OpenMetrics text.
+
+Pull-based, stdlib-only. `MetricsExporter` owns a background tick that
+(1) takes the run-wide aggregated snapshot (local registry + every
+worker lane, via telemetry/aggregate.py), (2) feeds it to the
+`AlertEngine` so burn-rate windows advance on a steady cadence whether
+or not anything scrapes, and (3) optionally atomic-writes the rendered
+text to a file (the sandboxed-run fallback — same payload a scraper
+would get, written via tmp + os.replace so a reader never sees a torn
+file). When `port` is set, a `ThreadingHTTPServer` serves GET /metrics
+with a FRESH snapshot per scrape (Prometheus semantics: the scrape is
+the sample). Port 0 binds an ephemeral port, exposed as `.port` — the
+tests and `tools/dash.py` use that.
+
+Text format is the OpenMetrics subset every Prometheus-lineage scraper
+accepts: `# TYPE <name> gauge` + `<name> <value>` lines, `# EOF`
+terminator. Key mangling: `telemetry/<path>` -> `impala_<path with /
+-> _>` (labels are already encoded in the path — proc<h>w<w> prefixes
+become part of the metric name, which keeps the exporter dependency-
+free; a relabel rule can split them back out server-side).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Mapping, Optional
+
+from torched_impala_tpu.telemetry.registry import (
+    PREFIX,
+    Registry,
+    get_registry,
+)
+
+CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+_MANGLE_PREFIX = "impala_"
+
+
+def metric_name(key: str) -> str:
+    """`telemetry/<c>/<n>` (or an aggregated `telemetry/<label>/<c>/<n>`)
+    -> the exposition name `impala_<c>_<n>` / `impala_<label>_<c>_<n>`."""
+    head, _, rest = key.partition("/")
+    path = rest if head == PREFIX and rest else key
+    return _MANGLE_PREFIX + path.replace("/", "_")
+
+
+def to_openmetrics(snap: Mapping[str, float]) -> str:
+    """Render a snapshot dict as OpenMetrics text. NaN series (unset
+    gauges, empty histograms) are skipped — absence beats NaN for every
+    scraper's rate()/alerting math."""
+    lines: List[str] = []
+    for key in sorted(snap):
+        value = snap[key]
+        if isinstance(value, float) and math.isnan(value):
+            continue
+        name = metric_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(value):.10g}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse_openmetrics(text: str) -> Dict[str, float]:
+    """Inverse of `to_openmetrics` for the dashboard and tests: metric
+    name -> value, comments/EOF skipped."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def write_metrics_file(path: str, text: str) -> None:
+    """Atomic publish of the exposition text: write a tmp file in the
+    target directory, fsync, os.replace — a concurrent reader sees the
+    old payload or the new one, never a torn mix."""
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        prefix=".metrics_", suffix=".tmp", dir=parent
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class MetricsExporter:
+    """The exposition loop. `provider()` must return a snapshot dict
+    (normally `lambda: get_aggregator().aggregated_snapshot()`); every
+    `interval_s` the exporter evaluates the alert engine against a
+    fresh snapshot and republishes the file fallback. The HTTP endpoint
+    renders its own fresh snapshot per scrape (alert gauges ride along
+    because the engine writes them into the registry between ticks —
+    scrapes never advance alert windows, so scrape rate cannot change
+    alerting behavior)."""
+
+    def __init__(
+        self,
+        provider: Callable[[], Mapping[str, float]],
+        *,
+        port: Optional[int] = None,
+        path: str = "",
+        interval_s: float = 1.0,
+        alert_engine=None,
+        registry: Optional[Registry] = None,
+    ):
+        # port=None: no HTTP endpoint; port=0: bind an ephemeral port
+        # (tests/dashboards read `.port` after start()); port>0: fixed.
+        if port is None and not path and alert_engine is None:
+            raise ValueError(
+                "MetricsExporter needs a port, a file path, or an "
+                "alert engine to be useful"
+            )
+        self._provider = provider
+        self._want_port = port
+        self._path = path
+        self._interval_s = max(0.05, float(interval_s))
+        self._engine = alert_engine
+        reg = registry if registry is not None else get_registry()
+        self._m_scrapes = reg.counter("export/scrapes")
+        self._m_ticks = reg.counter("export/ticks")
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._tick_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.port = 0
+
+    # -- rendering -------------------------------------------------------
+
+    def render(self) -> str:
+        return to_openmetrics(self._provider())
+
+    def _tick_once(self) -> None:
+        snap = self._provider()
+        if self._engine is not None:
+            self._engine.evaluate(snap)
+            # Alert gauges landed in the registry AFTER this snapshot
+            # was taken; fold their current values in so the file
+            # fallback (and anything reading it) sees alert state from
+            # the same tick.
+            if self._path:
+                snap = self._provider()
+        if self._path:
+            write_metrics_file(self._path, to_openmetrics(snap))
+        self._m_ticks.inc()
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self._tick_once()
+            except Exception:
+                # The exposition plane must never take down the run; a
+                # failed tick is retried on the next interval.
+                pass
+
+    # -- http ------------------------------------------------------------
+
+    def _make_handler(self):
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = exporter.render().encode("utf-8")
+                except Exception as e:  # pragma: no cover - defensive
+                    self.send_error(500, repr(e))
+                    return
+                exporter._m_scrapes.inc()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr
+                pass
+
+        return _Handler
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "MetricsExporter":
+        if self._want_port is not None:
+            self._server = ThreadingHTTPServer(
+                ("", self._want_port), self._make_handler()
+            )
+            self._server.daemon_threads = True
+            self.port = self._server.server_address[1]
+            self._server_thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="metrics-http",
+                daemon=True,
+            )
+            self._server_thread.start()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name="metrics-tick", daemon=True
+        )
+        self._tick_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=5.0)
+            self._tick_thread = None
+        if self._server is not None:
+            self._server.shutdown()
+            if self._server_thread is not None:
+                self._server_thread.join(timeout=5.0)
+                self._server_thread = None
+            self._server.server_close()
+            self._server = None
+        # One last publish so the file reflects final state.
+        if self._path:
+            try:
+                self._tick_once()
+            except Exception:
+                pass

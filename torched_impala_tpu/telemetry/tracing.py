@@ -105,9 +105,17 @@ class FlightRecorder:
     records. Thread-safe; writers take one short lock per record (the
     ring index + slot store), readers copy under the same lock."""
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+    def __init__(
+        self, capacity: int = DEFAULT_CAPACITY, process_label: str = ""
+    ):
         if capacity < 2:
             raise ValueError(f"capacity must be >= 2, got {capacity}")
+        # (pid, process label) stamp: worker-process recorders carry
+        # their pool label (proc<h>w<w>) so the cross-process trace
+        # merge (telemetry/aggregate.py) can name per-process rows; the
+        # parent's global recorder keeps the default empty label.
+        self.process_label = process_label
+        self.pid = os.getpid()
         # Round up to a power of two so the ring index is one AND.
         cap = 1
         while cap < capacity:
@@ -231,6 +239,7 @@ class FlightRecorder:
             events.append(ev)
             seen_tids.add((pid, tid))
         meta: List[dict] = []
+        label = self.process_label
         for component, pid in pids.items():
             meta.append(
                 {
@@ -238,7 +247,11 @@ class FlightRecorder:
                     "ph": "M",
                     "pid": pid,
                     "tid": 0,
-                    "args": {"name": component},
+                    "args": {
+                        "name": (
+                            f"{label}/{component}" if label else component
+                        )
+                    },
                 }
             )
         for pid, tid in sorted(seen_tids):

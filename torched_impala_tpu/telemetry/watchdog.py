@@ -77,10 +77,19 @@ class StallWatchdog:
         stream=None,
         recorder: Optional[FlightRecorder] = None,
         tail_records: int = 48,
+        aggregator=None,
+        alert_engine=None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self._registry = registry
+        # Observability plane hooks (telemetry/aggregate.py/alerts.py):
+        # with an aggregator the stall dump shows the CROSS-PROCESS
+        # snapshot — a wedged env-pool worker's own frozen counters are
+        # visible in the dump that fires about it — and with an alert
+        # engine it names the currently-firing alerts.
+        self._aggregator = aggregator
+        self._alert_engine = alert_engine
         # The flight recorder whose tail rides the stall dump (None =
         # the process-global one every pipeline stage records into).
         self._recorder = recorder if recorder is not None else get_recorder()
@@ -173,12 +182,29 @@ class StallWatchdog:
         stream.write(self._recorder.format_tail(self._tail_records))
         stream.flush()
         snap = self._registry.snapshot()
+        label = "registry snapshot"
+        if self._aggregator is not None:
+            try:
+                snap = self._aggregator.aggregated_snapshot(snap)
+                label = "aggregated snapshot (all processes)"
+            except Exception:
+                pass  # fall back to the local view
         print(
-            "[stall-watchdog] registry snapshot: "
+            f"[stall-watchdog] {label}: "
             + " ".join(f"{k}={v}" for k, v in sorted(snap.items())),
             file=stream,
             flush=True,
         )
+        if self._alert_engine is not None:
+            try:
+                print(
+                    "[stall-watchdog] "
+                    + self._alert_engine.format_status(),
+                    file=stream,
+                    flush=True,
+                )
+            except Exception:
+                pass
         self.fired.set()
         if self._on_stall is not None:
             try:
