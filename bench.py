@@ -363,6 +363,10 @@ def main(args) -> None:
     # env worker + crashed actor + crashed learner -> resume reaches the
     # target step count; async checkpoint overhead < 1%).
     section("chaos", lambda: run_bench_chaos(jax))
+    # Host-side: simulated multi-host pod (ISSUE 18 acceptance: 2-host
+    # weak-scaling efficiency >= 0.8 with env-paced feeds, all-reduce
+    # overlap >= 0.8, kill_host chaos recovered to the return target).
+    section("multihost", lambda: run_bench_multihost(jax))
     # Host-side: serving tier (ISSUE 6 acceptance: coalesced batching
     # >= 3x per-request actions/s at 64 clients, shadow traffic <= 5%
     # primary-wave latency, bf16 passes the greedy parity gate).
@@ -3174,6 +3178,184 @@ def run_bench_chaos(jax, tiny: bool = False) -> dict:
     log(f"bench: chaos: {out}")
     _history_append(
         "chaos", {"steps_per_sec_off": out["steps_per_sec_off"]}, tiny=tiny
+    )
+    return out
+
+
+def run_bench_multihost(
+    jax, tiny: bool = False, chaos_arm: bool = True
+) -> dict:
+    """Multi-host pod-slice bench (ISSUE 18 acceptance): weak-scaling
+    efficiency of the simulated cluster, all-reduce overlap, and the
+    kill_host chaos recovery scenario.
+
+    Every arm launches REAL multi-process clusters (parallel/simhost.py:
+    each child is its own jax controller pinned to the CPU backend with
+    gloo collectives — also on TPU boxes, so the numbers are
+    backend-stable and the rows append under the cpu fingerprint).
+
+    - Weak scaling: each host carries the SAME local load (local batch,
+      actor fleet, straggler-paced envs); a perfect pod doubles global
+      frames/s when hosts double. Envs sleep `env_delay_s` per step so
+      env pacing — not the single shared CPU core — dominates the step,
+      which is what lets two simulated hosts interleave on a 1-core box
+      at all (real pods give each host its own cores; this arm measures
+      the harness's coordination overhead, not CPU contention). Two
+      measurement traps, both fixed by construction: (a) actors bank
+      unrolls in the feed queue while step 1 compiles, and a short run's
+      "steady" window then measures queue DRAIN speed, not paced
+      production — so the arms cap actor lead (queue_capacity override)
+      and take the window over the run's SECOND HALF (log_every =
+      steps//2 puts exactly two log calls at steps//2 and steps, long
+      after the backlog is gone); (b) each log call materializes device
+      scalars (a sync), and on a contended 1-core box any sync can eat a
+      scheduler-quantum stall that debits the overlap gauge — two sync
+      sites bound that debit at 2 steps' worth of estimate.
+      `multihost_weak_scaling_eff = fps(2 hosts, global 2B) /
+      (2 * fps(1 host, global B))`, budget min 0.8.
+    - `allreduce_overlap_frac` (min over hosts of the 2-host run's
+      perf/allreduce_overlap_frac gauge): the fraction of the ring
+      all-reduce cost-model estimate the learner hid behind the step,
+      budget min 0.8.
+    - kill_host chaos: a 2-host checkpointed run on the learnable
+      VectorSignalEnv with the traj_ring feed; the fault SIGKILLs host 1
+      mid-ring-commit, the launcher reaps the corpse and kills the
+      blocked survivor, `launch_with_recovery` relaunches with
+      resume=True and the plan disarmed, and the resumed run must reach
+      the target step count AND the return target (the run still LEARNS
+      after losing a host, not merely steps).
+
+    tests/test_bench_units.py asserts the tiny variant with
+    `chaos_arm=False` — the kill_host recovery scenario is pinned
+    end-to-end by tests/test_multihost.py already, and two extra cluster
+    relaunches inside the tier-1 wall-clock budget buy no new
+    coverage."""
+    import shutil
+    import tempfile
+
+    from torched_impala_tpu.runtime import distributed
+
+    if tiny:
+        steps, b_local, T, delay = 20, 2, 4, 0.015
+        chaos_steps, return_target = 30, 5.0
+    else:
+        steps, b_local, T, delay = 30, 4, 5, 0.02
+        chaos_steps, return_target = 60, 6.0
+
+    out: dict = {"hosts": 2, "local_batch": b_local, "steps": steps}
+
+    # -- weak scaling + allreduce overlap -------------------------------
+    base = dict(
+        devices_per_host=1,
+        total_steps=steps,
+        unroll_length=T,
+        num_actors=1,
+        envs_per_actor=b_local,
+        seed=3,
+        env_delay_s=delay,
+        # Two log calls (steps//2, steps): the steady window is the paced
+        # second half, and sync-stall debits against the overlap gauge
+        # are bounded at two steps' estimate (see docstring).
+        log_every=steps // 2,
+        # One batch of actor lead: the compile-time backlog drains within
+        # a couple of steps instead of masking paced production.
+        learner_overrides={"queue_capacity": b_local},
+    )
+    one = distributed.DistSpec(num_hosts=1, batch_size=b_local, **base)
+    two = distributed.DistSpec(num_hosts=2, batch_size=2 * b_local, **base)
+    res1 = distributed.launch_cluster(one, timeout=240)
+    if not res1.ok:
+        raise RuntimeError(f"1-host arm failed: {res1.describe()}")
+    res2 = distributed.launch_cluster(two, timeout=240)
+    if not res2.ok:
+        raise RuntimeError(f"2-host arm failed: {res2.describe()}")
+    p1 = res1.hosts[0].results()[-1]
+    p2 = [h.results()[-1] for h in res2.hosts]
+    fps1 = p1["steady_frames_per_s"] or 0.0
+    # Both controllers report the same global program; min = the slower
+    # controller's view of it (conservative).
+    fps2 = min(p["steady_frames_per_s"] or 0.0 for p in p2)
+    eff = fps2 / (2.0 * fps1) if fps1 > 0 else 0.0
+    overlap = min(
+        (p["allreduce_overlap_frac"] or 0.0) for p in p2
+    )
+    out["fps_1host"] = fps1
+    out["fps_2host"] = fps2
+    out["multihost_weak_scaling_eff"] = round(eff, 4)
+    out["allreduce_overlap_frac"] = round(overlap, 4)
+    out["allreduce_ns_total"] = p2[0].get("allreduce_ns_total")
+
+    # -- kill_host chaos recovery ---------------------------------------
+    if not chaos_arm:
+        log(f"bench: multihost: {out}")
+        _history_append(
+            "multihost",
+            {
+                "multihost_weak_scaling_eff": out[
+                    "multihost_weak_scaling_eff"
+                ],
+                "allreduce_overlap_frac": out["allreduce_overlap_frac"],
+            },
+            tiny=tiny,
+            backend="cpu",  # the simulated pod is CPU-by-construction
+        )
+        return out
+    ckdir = tempfile.mkdtemp(prefix="bench_multihost_")
+    try:
+        chaos_spec = distributed.DistSpec(
+            num_hosts=2,
+            devices_per_host=1,
+            total_steps=chaos_steps,
+            batch_size=4,
+            unroll_length=5,
+            num_actors=1,
+            envs_per_actor=2,
+            seed=11,
+            env="signal",
+            num_actions=2,
+            episode_len=8,
+            optimizer="adam",
+            learning_rate=1e-2,
+            entropy_cost=0.001,
+            learner_overrides={"traj_ring": True},
+            checkpoint_dir=ckdir,
+            checkpoint_interval=2,
+            chaos=[{"kind": "kill_host", "at": 3}],
+            chaos_host=1,
+        )
+        final, attempts = distributed.launch_with_recovery(
+            chaos_spec, max_restarts=2, timeout=300
+        )
+        out["chaos_attempts"] = len(attempts)
+        out["chaos_first_attempt_died"] = not attempts[0].ok
+        out["chaos_recovered"] = final.ok
+        if final.ok:
+            payloads = [h.results()[-1] for h in final.hosts]
+            out["chaos_final_steps"] = max(p["steps"] for p in payloads)
+            tails = [
+                p["episode_return_mean_tail"]
+                for p in payloads
+                if p.get("episode_return_mean_tail") is not None
+            ]
+            out["chaos_return_tail"] = (
+                round(max(tails), 3) if tails else None
+            )
+            out["chaos_reached_return_target"] = bool(
+                tails and max(tails) >= return_target
+            )
+            out["chaos_return_target"] = return_target
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+    log(f"bench: multihost: {out}")
+    _history_append(
+        "multihost",
+        {
+            "multihost_weak_scaling_eff": out["multihost_weak_scaling_eff"],
+            "allreduce_overlap_frac": out["allreduce_overlap_frac"],
+        },
+        tiny=tiny,
+        backend="cpu",  # the simulated pod is CPU-by-construction
     )
     return out
 

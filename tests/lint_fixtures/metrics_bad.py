@@ -22,6 +22,7 @@ reg.gauge("alerts/burning")  # subfamily-prefix (3h: burn_ prefix, not substring
 reg.counter("alerts/orphan_series")  # subfamily-prefix (rule 3h)  # noqa: F821
 bad_agg = "telemetry/proc0wx/pool/step_ms"  # agg-prefix (malformed label)  # noqa: F821
 bad_agg2 = "telemetry/proc0w1/0bad/step"  # agg-prefix (bad remainder)  # noqa: F821
+bad_agg3 = "telemetry/proc1x2w0/pool/step_ms"  # agg-prefix (junk inside a multi-host label)  # noqa: F821
 rec.instant("Bad.Trace")  # trace-grammar  # noqa: F821
 rec.complete("serving/rogue_event", 0, 1)  # trace-closed-set  # noqa: F821
 rec.instant("serving/rollback")  # trace-closed-set (rollout is pinned, rollback is not)  # noqa: F821

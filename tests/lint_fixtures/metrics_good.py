@@ -25,6 +25,7 @@ reg.gauge("alerts/firing_pool_step_p99")  # pinned sub-family (3h)  # noqa: F821
 reg.gauge("alerts/burn_rate_pool_step_p99")  # pinned sub-family (3h)  # noqa: F821
 key = "telemetry/pool/restarts"
 agg_key = "telemetry/proc0w1/pool/worker_step_ms_p50"  # aggregated form (3i)
+agg_key_mh = "telemetry/proc12w3/pool/worker_step_ms_p50"  # multi-host form: h is a real process index (ISSUE 18)
 rec.instant("telemetry/alert", {"slo": "pool_step_p99"})  # trace name, not a metric key  # noqa: F821
 rec.instant("ring/commit", {"lid": "a0u0"})  # noqa: F821
 rec.complete("serving/request", 0, 1)  # pinned trace set  # noqa: F821

@@ -341,6 +341,85 @@ def test_control_bench_controller_no_worse_than_static(jax_cpu):
     assert serving["controller_vs_static"] >= 1.2, out
 
 
+def test_multihost_bench_weak_scaling_and_overlap(jax_cpu):
+    """The ISSUE 18 acceptance bounds, wired into CI via the bench
+    multihost section's tiny variant: a REAL 2-process simulated pod
+    (jax.distributed + gloo on CPU) holding per-host load fixed must
+    keep >= 0.8 of perfect 2x frame throughput over the 1-process run
+    of the same spec, and the learner must hide >= 0.8 of the ring
+    all-reduce cost estimate behind the step. Envs are straggler-paced
+    so production — not the single shared core — dominates, and the
+    steady window is the backlog-free second half of each run (see
+    run_bench_multihost's docstring for both measurement traps). The
+    kill_host chaos arm is skipped here: tests/test_multihost.py pins
+    that recovery end-to-end already."""
+    from bench import run_bench_multihost
+
+    out = run_bench_multihost(jax_cpu, tiny=True, chaos_arm=False)
+    assert out["fps_1host"] > 0, out
+    assert out["multihost_weak_scaling_eff"] >= 0.8, out
+    # Near-perfect scaling is the claim, but the quotient must also be
+    # PLAUSIBLE: >> 1 means the 1-host arm was serving backlog, not
+    # producing (the trap this bench exists to avoid).
+    assert out["multihost_weak_scaling_eff"] <= 1.3, out
+    assert out["allreduce_overlap_frac"] >= 0.8, out
+    assert "chaos_attempts" not in out
+
+
+def test_multihost_budgets_pinned_in_perfgate():
+    """The multihost floors are load-bearing: eff and overlap records
+    must be gated by pinned budgets on both the tiny (CI) and full
+    rows, and a violating record must produce a finding. no_drop_check:
+    both metrics are quotients of second-scale wall times on a
+    contended 1-core box — the absolute floor IS the claim."""
+    from tools.perfgate import BUDGETS, check_records
+
+    assert BUDGETS["tiny_multihost_weak_scaling_eff"] == {
+        "min": 0.8,
+        "fingerprint_contains": "cpu",
+        "no_drop_check": True,
+    }
+    assert BUDGETS["tiny_allreduce_overlap_frac"] == {
+        "min": 0.8,
+        "fingerprint_contains": "cpu",
+        "no_drop_check": True,
+    }
+    assert BUDGETS["multihost_weak_scaling_eff"] == {
+        "min": 0.8,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    }
+    assert BUDGETS["allreduce_overlap_frac"] == {
+        "min": 0.8,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    }
+
+    def rec(metric, value):
+        return {
+            "metric": metric,
+            "value": value,
+            "direction": "higher",
+            "fingerprint": "vm|x86_64|cpu1|cpu",
+            "sha": "deadbeef",
+        }
+
+    good = [
+        rec("tiny_multihost_weak_scaling_eff", 0.97),
+        rec("tiny_allreduce_overlap_frac", 1.0),
+    ]
+    assert check_records(good) == []
+    findings = check_records(
+        [
+            rec("tiny_multihost_weak_scaling_eff", 0.55),
+            rec("tiny_allreduce_overlap_frac", 0.4),
+        ]
+    )
+    assert len(findings) == 2, findings
+    assert any("weak_scaling" in f for f in findings)
+    assert any("overlap" in f for f in findings)
+
+
 def test_perfgate_gates_tiny_bench_history(jax_cpu, tmp_path, monkeypatch):
     """The ISSUE 10 bench-history loop, end to end on CI: a tiny bench
     section appends `tiny_*` records to $BENCH_HISTORY_PATH, perfgate

@@ -265,6 +265,10 @@ class TestMetricsChecker:
         # malformed remainder both fire
         assert "proc0wx/pool/step_ms" in msgs
         assert "proc0w1/0bad/step" in msgs
+        # 3i multi-host grammar (ISSUE 18): h is a REAL process index,
+        # so multi-digit hosts are legal (proc12w3 lives in the good
+        # fixture) but junk inside the label still fires
+        assert "proc1x2w0/pool/step_ms" in msgs
         # 4b closed set: serving/rollout is pinned, serving/rollback
         # is not
         assert "serving/rollback" in msgs

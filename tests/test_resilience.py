@@ -156,6 +156,74 @@ class TestRecovery:
             state["params"]["bias"], _state(2.0)["params"]["bias"]
         )
 
+    def _write_ck(self, d, host_count, step=4):
+        save_state_file(recovery.checkpoint_path(d, step), _state(3.0))
+        write_manifest(
+            d,
+            recovery.RunManifest(
+                step=step,
+                param_version=40,
+                checkpoint=os.path.basename(
+                    recovery.checkpoint_path(d, step)
+                ),
+                host_count=host_count,
+            ),
+        )
+
+    def test_restore_under_host_turnover_reshards(self, tmp_path, capsys):
+        """ISSUE 18 satellite: an N-host checkpoint restores into an
+        M-host run when the global batch still divides — params are
+        replicated, so they reshard through the SpecLayout placement
+        tables — and says so loudly."""
+        d = str(tmp_path)
+        self._write_ck(d, host_count=2)
+        # 2-host checkpoint -> 1-host run (scale down).
+        manifest, state = restore_latest(
+            d, _state(), host_count=1, global_batch_size=8
+        )
+        assert manifest.step == 4 and manifest.host_count == 2
+        err = capsys.readouterr().err
+        assert "2-host" in err and "1-host" in err
+        # 1-host checkpoint -> 2-host run (scale up), other direction.
+        d2 = str(tmp_path / "up")
+        os.makedirs(d2)
+        self._write_ck(d2, host_count=1)
+        manifest, state = restore_latest(
+            d2, _state(), host_count=2, global_batch_size=8
+        )
+        assert manifest.host_count == 1
+        err = capsys.readouterr().err
+        assert "1-host" in err and "2-host" in err
+        # Same host count: silent, no turnover notice.
+        manifest, state = restore_latest(
+            d, _state(), host_count=2, global_batch_size=8
+        )
+        assert "reshard" not in capsys.readouterr().err
+
+    def test_restore_host_turnover_indivisible_refuses(self, tmp_path):
+        """When the global batch does NOT divide over the new host
+        count, restore refuses loudly, naming both counts — silently
+        changing batch semantics mid-run is worse than dying."""
+        from torched_impala_tpu.resilience import HostCountMismatch
+
+        d = str(tmp_path)
+        self._write_ck(d, host_count=2)
+        with pytest.raises(HostCountMismatch) as ei:
+            restore_latest(d, _state(), host_count=3, global_batch_size=8)
+        msg = str(ei.value)
+        assert "2-host" in msg and "3 hosts" in msg and "8" in msg
+
+    def test_manifest_host_count_default_backcompat(self, tmp_path):
+        """Manifests written before host_count existed load as 1-host."""
+        blob = recovery.RunManifest(
+            step=1, param_version=1, checkpoint="ck.npz"
+        ).to_json()
+        obj = json.loads(blob)
+        assert obj["host_count"] == 1
+        del obj["host_count"]
+        m = recovery.RunManifest.from_json(json.dumps(obj))
+        assert m.host_count == 1
+
     def test_corrupt_newest_falls_back(self, tmp_path, capsys):
         d = str(tmp_path)
         for step, seed in ((2, 1.0), (5, 2.0)):

@@ -107,6 +107,38 @@ class TestRingMechanics:
         with pytest.raises(RuntimeError, match="stale ring block"):
             ring.commit(stale, 1)
 
+    def test_discard_torn_reclaims_half_committed_slot(self):
+        """ISSUE 18 satellite: a writer SIGKILLed mid-commit (kill_host
+        chaos) leaves a slot with partial progress — neither free nor
+        ready. Restore-time discard_torn() must reclaim it, never
+        deliver it, and fence the dead writer's block via the
+        generation bump."""
+        ring = _ring(B=4, num_slots=2)
+        # Nothing in flight: nothing to discard.
+        assert ring.discard_torn() == 0
+        a = ring.acquire(2)
+        zombie = ring.acquire(2)
+        ring.commit(a, param_version=3)
+        # Half committed: not ready, not free — torn if the writer of
+        # `zombie` never comes back.
+        assert ring.pop_ready(timeout=0.05) is None
+        assert ring.discard_torn() == 1
+        # The torn slot went straight back to the free list and its
+        # partial contents are never delivered.
+        assert len(ring._free) == 2
+        assert ring.pop_ready(timeout=0.05) is None
+        # The dead writer's commit arriving after the discard (a zombie
+        # process that hadn't died yet) hits the generation fence.
+        with pytest.raises(RuntimeError, match="stale ring block"):
+            ring.commit(zombie, 1)
+        # A READY slot is not torn: full commit survives a discard pass.
+        c = ring.acquire(4)
+        ring.commit(c, 5)
+        assert ring.discard_torn() == 0
+        view = ring.pop_ready(timeout=1.0)
+        assert view is not None and view.param_version == 5
+        ring.release(view.slot)
+
     def test_abort_recycles_slot_without_delivering(self):
         ring = _ring(B=4, num_slots=2)
         a = ring.acquire(2)

@@ -22,6 +22,9 @@ from torched_impala_tpu.envs.fake import (  # noqa: F401
     FakeAtariEnv,
     FakeDiscreteEnv,
     ScriptedEnv,
+    StragglerEnv,
+    StragglerFactory,
+    VectorSignalEnv,
 )
 
 __all__ = [
@@ -38,6 +41,9 @@ __all__ = [
     "JaxEnvGymWrapper",
     "JaxPixelSignal",
     "ScriptedEnv",
+    "StragglerEnv",
+    "StragglerFactory",
+    "VectorSignalEnv",
     "make_atari",
     "make_cartpole",
     "make_dmlab",

@@ -174,6 +174,46 @@ class SignalEnv:
         return self._obs(), reward, self._t >= self._episode_len, False, {}
 
 
+class VectorSignalEnv:
+    """Vector cousin of `SignalEnv`: the rewarded action IS the one-hot obs.
+
+    Same contract — match the target for reward 1, fresh target every
+    step, random policy averages episode_len/num_actions per episode —
+    but the observation is a float32 one-hot vector, so an MLP torso
+    learns it in a handful of SGD steps. This is the cheapest env with a
+    genuine learning signal, which makes it the return-target probe for
+    CPU-budget recovery scenarios (bench.py multihost kill_host chaos:
+    prove the resumed run still LEARNS, not merely that it steps).
+    """
+
+    def __init__(self, num_actions: int = 2, episode_len: int = 8, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._num_actions = num_actions
+        self._episode_len = episode_len
+        self._t = 0
+        self._target = 0
+
+    @property
+    def action_space_n(self) -> int:
+        return self._num_actions
+
+    def _obs(self) -> np.ndarray:
+        obs = np.zeros((self._num_actions,), np.float32)
+        obs[self._target] = 1.0
+        return obs
+
+    def reset(self, seed=None):
+        self._t = 0
+        self._target = int(self._rng.integers(self._num_actions))
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._target else 0.0
+        self._t += 1
+        self._target = int(self._rng.integers(self._num_actions))
+        return self._obs(), reward, self._t >= self._episode_len, False, {}
+
+
 class TaskSignalEnv:
     """Learnable MULTI-task env: per-task action mapping and reward scale.
 

@@ -41,6 +41,25 @@ from torched_impala_tpu.telemetry.registry import Registry, get_registry
 PEAK_FLOPS_BF16 = 197e12
 PEAK_HBM_BYTES_PER_S = 819e9
 
+# Interconnect bandwidth for the data-axis gradient all-reduce cost
+# model (learner perf/allreduce_* telemetry). v5e ICI is a 1D ring at
+# ~45 GB/s per link per direction — ~9e10 B/s of ring all-reduce
+# bandwidth per chip. Simulated CPU pods move gradients over loopback
+# gloo TCP; 4 GB/s is the measured order of magnitude on this image.
+ICI_BYTES_PER_S = 9e10
+LOOPBACK_BYTES_PER_S = 4e9
+
+
+def allreduce_ns(nbytes: float, n_shards: int, bytes_per_s: float) -> int:
+    """Ring all-reduce wall-time estimate: 2(n-1)/n * bytes / bandwidth.
+
+    The standard bidirectional-ring cost (scaling-book collective
+    table): each of n shards sends/receives 2(n-1)/n of the payload.
+    Returns 0 when there is nothing to reduce (n<=1 or empty)."""
+    if n_shards <= 1 or nbytes <= 0 or bytes_per_s <= 0:
+        return 0
+    return int(2 * (n_shards - 1) / n_shards * nbytes / bytes_per_s * 1e9)
+
 
 @dataclasses.dataclass
 class RootCost:

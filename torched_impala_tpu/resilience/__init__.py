@@ -23,6 +23,7 @@ from torched_impala_tpu.resilience.chaos import (
     corrupt_file,
 )
 from torched_impala_tpu.resilience.recovery import (
+    HostCountMismatch,
     RunManifest,
     ResumeConfigMismatch,
     config_fingerprint,
@@ -39,6 +40,7 @@ __all__ = [
     "ChaosPlan",
     "Fault",
     "corrupt_file",
+    "HostCountMismatch",
     "RunManifest",
     "ResumeConfigMismatch",
     "config_fingerprint",

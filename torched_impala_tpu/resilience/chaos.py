@@ -41,6 +41,14 @@ Fault kinds and the hook site each rides:
   wedge_shm_ring      pump      stall the shm request-ring pump for
                                 `duration_s` — a wedged cross-process
                                 transport under live clients
+  kill_host           ring_commit  SIGKILL THIS whole OS process at the
+                                top of a trajectory-ring block commit —
+                                a simulated pod host dying mid-write
+                                (parallel/simhost.py clusters). No
+                                teardown, no final checkpoint, the slot
+                                left torn; the survivor-driven restart
+                                must discard it (`discard_torn`) and
+                                resume from the last durable checkpoint
 
 Sites count monotonically from 1; a fault fires when its site's counter
 reaches `at` (once — every fault is one-shot). The injector is
@@ -71,6 +79,7 @@ KINDS = (
     "kill_server_mid_wave",
     "corrupt_pinned_version",
     "wedge_shm_ring",
+    "kill_host",
 )
 
 _SITE_OF = {
@@ -83,6 +92,7 @@ _SITE_OF = {
     "kill_server_mid_wave": "serving",
     "corrupt_pinned_version": "serving",
     "wedge_shm_ring": "pump",
+    "kill_host": "ring_commit",
 }
 
 
@@ -282,6 +292,18 @@ class ChaosInjector:
                 server.kill(reason="chaos kill_server_mid_wave")
             elif f.kind == "corrupt_pinned_version":
                 corrupt_pinned_params(server.registry)
+
+    def ring_commit_hook(self, slot: int = -1) -> None:
+        """Attach as `TrajectoryRing.chaos_hook`; called with the slot
+        index at the top of every block commit. kill_host SIGKILLs THIS
+        process while the slot is torn (columns handed out, commit not
+        counted) — the abrupt death of one simulated pod host. The
+        multi-host launcher (parallel/simhost.py) reaps the corpse and
+        kills the survivors blocked in collectives; recovery relaunches
+        the cluster with resume=True and the chaos plan disarmed."""
+        for f in self._trigger("ring_commit", target=slot):
+            if f.kind == "kill_host":
+                os.kill(os.getpid(), signal.SIGKILL)
 
     def pump_hook(self, pump=None) -> None:
         """Attach as `ShmRingPump.chaos_hook`; wedge_shm_ring stalls one

@@ -59,6 +59,7 @@ class AsyncCheckpointer:
         config_hash: Optional[str] = None,
         telemetry: Optional[Registry] = None,
         post_save: Optional[Callable[[str, int], None]] = None,
+        host_count: Optional[int] = None,
     ) -> None:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -66,6 +67,11 @@ class AsyncCheckpointer:
         self._interval_steps = interval_steps
         self._interval_seconds = interval_seconds
         self._config_hash = config_hash
+        # Stamped into every manifest so resume can validate restoring
+        # into a different topology (recovery.HostCountMismatch).
+        self._host_count = (
+            int(host_count) if host_count is not None else jax.process_count()
+        )
         # Chaos hook: called (checkpoint_path, step) after each completed
         # save — the fault-injection seam `corrupt_checkpoint` uses.
         self._post_save = post_save
@@ -279,6 +285,7 @@ class AsyncCheckpointer:
                     config_hash=self._config_hash,
                     rng=rng,
                     saved_at=time.time(),
+                    host_count=self._host_count,
                 ),
             )
             recovery.prune(self.directory, self._keep)

@@ -46,6 +46,16 @@ LATEST_MANIFEST = "MANIFEST.json"
 _FORMAT_VERSION = 1
 
 
+class HostCountMismatch(RuntimeError):
+    """--resume pointed at checkpoints written by a run with a DIFFERENT
+    host count, and the new topology cannot take the checkpoint: the
+    global batch does not divide over the new hosts. Host-count CHANGES
+    are supported (params are replicated, so an N-host checkpoint
+    reshards into an M-host mesh through the SpecLayout placement
+    tables) — this error fires only when the restored run's global
+    semantics could not be preserved."""
+
+
 class ResumeConfigMismatch(RuntimeError):
     """--resume pointed at checkpoints written under a DIFFERENT config
     (hash mismatch). Refusing is deliberate: restoring opt state and step
@@ -88,6 +98,10 @@ class RunManifest:
     rng: Optional[List[int]] = None  # raw uint32 key data, resume audit
     saved_at: float = 0.0  # unix seconds
     format: int = _FORMAT_VERSION
+    # Processes in the run that wrote this checkpoint (jax.process_count).
+    # Resume into a different host count reshards via SpecLayout when the
+    # global batch still divides; `restore_latest` refuses otherwise.
+    host_count: int = 1
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -149,6 +163,8 @@ def restore_latest(
     target: Any,
     *,
     config_hash: Optional[str] = None,
+    host_count: Optional[int] = None,
+    global_batch_size: Optional[int] = None,
 ) -> Optional[Tuple[RunManifest, Any]]:
     """Load the newest loadable (manifest, state) pair from `directory`.
 
@@ -158,7 +174,15 @@ def restore_latest(
     recoverable, a wrong config is not. Checkpoints that fail their CRCs
     are skipped with a stderr warning, falling back to the previous
     retained step; raises `CheckpointCorruptError` when every retained
-    checkpoint is damaged."""
+    checkpoint is damaged.
+
+    Host turnover: pass this run's `host_count` (jax.process_count) and
+    its `global_batch_size` to validate restoring an N-host checkpoint
+    into an M-host run. A count CHANGE is fine — params are replicated,
+    so they reshard into the new mesh through the SpecLayout placement
+    tables, logged loudly — but when the global batch no longer divides
+    over the new hosts the restore raises `HostCountMismatch` naming
+    both counts instead of silently changing batch semantics."""
     steps = list_manifest_steps(directory)
     if not steps:
         return None
@@ -187,6 +211,32 @@ def restore_latest(
                 "opt state/step counters across configs desynchronizes "
                 "the lr schedule and frame budget. Use the original "
                 "config, or point --checkpoint-dir at a fresh directory."
+            )
+        if (
+            not hash_checked
+            and host_count is not None
+            and manifest.host_count != host_count
+        ):
+            if (
+                global_batch_size is not None
+                and global_batch_size % host_count
+            ):
+                raise HostCountMismatch(
+                    f"checkpoints in {directory} were written by a "
+                    f"{manifest.host_count}-host run; this run has "
+                    f"{host_count} hosts and the global batch "
+                    f"{global_batch_size} does not divide over them, so "
+                    "the restored run's batch semantics cannot be "
+                    "preserved. Resume with a host count that divides "
+                    "the global batch, or start fresh."
+                )
+            print(
+                f"[resume] checkpoint written by a "
+                f"{manifest.host_count}-host run restoring into a "
+                f"{host_count}-host run; replicated params reshard "
+                "through the SpecLayout placement tables",
+                file=sys.stderr,
+                flush=True,
             )
         hash_checked = True
         ckpt = os.path.join(directory, manifest.checkpoint)

@@ -150,6 +150,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "command with its own --host-id")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
+    p.add_argument("--simulate-hosts", type=int, default=None, metavar="N",
+                   help="multi-host without a pod: re-exec this same "
+                        "command as N CPU processes (one jax controller "
+                        "each, gloo collectives, loopback coordinator) "
+                        "and run it as an N-host cluster — the "
+                        "parallel/simhost.py harness behind the tier-1 "
+                        "multi-host tests and the bench multihost "
+                        "section (docs/MULTIHOST.md)")
     # Environments.
     p.add_argument("--env-id", default=None,
                    help="override the preset's env id (e.g. a different "
@@ -446,6 +454,34 @@ def main(argv=None) -> int:
         return run_doctor(args.config)
     if args.config is None:
         raise SystemExit("--config is required (unless --doctor)")
+    if args.simulate_hosts:
+        import os
+
+        from torched_impala_tpu.parallel import multihost, simhost
+
+        if os.environ.get(multihost.ENV_HOST_ID) is None:
+            # Parent: re-exec this exact command as N simulated host
+            # processes (simhost sets the IMPALA_* triple per child; the
+            # children fall through to bootstrap() below).
+            res = simhost.launch(
+                [sys.executable, "-m", "torched_impala_tpu.run"]
+                + list(argv if argv is not None else sys.argv[1:]),
+                args.simulate_hosts,
+            )
+            for h in res.hosts:
+                tail = "\n".join(
+                    (h.stdout + "\n" + h.stderr).strip().splitlines()[-6:]
+                )
+                print(
+                    f"[simulate-hosts] host {h.host_id} "
+                    f"rc={h.returncode}\n{tail}"
+                )
+            print(
+                f"[simulate-hosts] cluster "
+                f"{'ok' if res.ok else 'FAILED'} in {res.duration_s:.1f}s"
+            )
+            return 0 if res.ok else 1
+        multihost.bootstrap()
     if args.coordinator or args.num_hosts or args.host_id is not None:
         from torched_impala_tpu.parallel import multihost
 
@@ -534,6 +570,14 @@ def main(argv=None) -> int:
     elif cfg.dp_devices:  # 0 = single-device; -1 = all; N = N devices
         n = len(jax.devices()) if cfg.dp_devices == -1 else cfg.dp_devices
         mesh = make_mesh(num_data=n)
+    elif jax.process_count() > 1:
+        # Multi-controller run (--simulate-hosts / --coordinator) with no
+        # explicit mesh flags: a mesh is NOT optional — without one each
+        # controller would train its own independent copy. Default to
+        # data-parallel over every device in the pod.
+        from torched_impala_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh()
 
     agent = configs.make_agent(cfg, mesh=mesh)
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import queue
+import sys
 import threading
 import time
 import weakref
@@ -66,6 +67,13 @@ from torched_impala_tpu.runtime.types import (
     host_snapshot,
     tree_nbytes,
 )
+
+# Minimum excess wall time (ns) a calibrated host sync must show before
+# it is debited against the all-reduce overlap budget. Back-to-back
+# `block_until_ready` pairs on a contended host routinely differ by tens
+# of microseconds from scheduler jitter alone; real collective exposure
+# at pod scale is milliseconds, so readings under this floor are noise.
+_SYNC_NOISE_FLOOR_NS = 25_000
 
 
 @dataclasses.dataclass(frozen=True)
@@ -607,6 +615,30 @@ class Learner:
         self._m_h2d_total_ns = reg.counter("perf/h2d_ns_total")
         self._m_h2d_overlap_ns = reg.counter("perf/h2d_ns_overlapped")
         self._m_h2d_overlap_frac = reg.gauge("perf/h2d_overlap_frac")
+        # Gradient all-reduce overlap (meshes whose data axis spans >1
+        # device — multi-host pods ride the same axis): XLA fuses the
+        # collective into the step program, so it can't be timed
+        # directly from the host. Instead each step accrues the ring
+        # all-reduce's COST MODEL estimate (2(n-1)/n * grad bytes /
+        # backend bandwidth) and debits every measured host stall on
+        # step completion (donated-slot probe blocks, log-leaf
+        # materialization) against it. The gauge is the cumulative
+        # fraction of estimated collective time NOT covered by measured
+        # stalls — i.e. hidden behind backward compute + pipeline slack.
+        # Conservative by construction: ALL completion stalls debit the
+        # collective, so a reduction-bound learner reads low before it
+        # reads high. docs/OBSERVABILITY.md documents the semantics.
+        self._m_allreduce_total_ns = reg.counter("perf/allreduce_ns_total")
+        self._m_allreduce_overlap_ns = reg.counter(
+            "perf/allreduce_ns_overlapped"
+        )
+        self._m_allreduce_overlap_frac = reg.gauge(
+            "perf/allreduce_overlap_frac"
+        )
+        self._allreduce_est_ns: Optional[int] = None  # lazily costed
+        self._allreduce_stall_ns = 0  # lint: guarded-by(gil)
+        self._allreduce_total_ns = 0  # lint: guarded-by(gil)
+        self._allreduce_overlap_ns = 0  # lint: guarded-by(gil)
         self._m_donated_batches = reg.counter("learner/donated_batches")
         # Written only by the batcher thread (directly or via the
         # place_batch per-shard callback); main thread only reads at
@@ -1641,6 +1673,59 @@ class Learner:
             )
         return ov
 
+    def _timed_sync(self, tree) -> None:
+        """block_until_ready(tree), crediting only the GENUINE device
+        wait to the allreduce stall accumulator: a second block on the
+        now-ready tree measures the pure API/host overhead of the call
+        itself, and only the first call's excess over twice that
+        baseline counts. On a synchronous backend (CPU) both calls cost
+        the same few microseconds and the stall reads ~0 — correct,
+        since nothing was left executing for the host to wait on."""
+        if not self._allreduce_est_ns:
+            # No collective to account for: plain block, no calibration.
+            jax.block_until_ready(tree)
+            return
+        t0 = time.monotonic_ns()
+        jax.block_until_ready(tree)
+        waited = time.monotonic_ns() - t0
+        t1 = time.monotonic_ns()
+        jax.block_until_ready(tree)
+        baseline = time.monotonic_ns() - t1
+        excess = waited - 2 * baseline
+        # Scheduler-quantum noise floor: on a contended host a pair of
+        # back-to-back calls can differ by tens of microseconds without
+        # any device wait at all. Collective exposure that matters at
+        # production scale is >= milliseconds; drop sub-floor readings
+        # instead of letting contention jitter masquerade as stalls.
+        if excess > _SYNC_NOISE_FLOOR_NS:
+            self._allreduce_stall_ns += excess
+
+    def _cost_allreduce_ns(self) -> int:
+        """Per-step gradient all-reduce estimate for this learner's mesh.
+
+        Ring cost over the data axis (perf/costmodel.allreduce_ns) on
+        the full gradient payload (grads mirror the param tree). 0 when
+        there is no mesh or the data axis is a single device — the
+        gauge then stays unset, which is the honest reading (there IS
+        no cross-shard reduction to hide)."""
+        if self._mesh is None:
+            return 0
+        n = int(dict(self._mesh.shape).get("data", 1))
+        if n <= 1:
+            return 0
+        from torched_impala_tpu.perf import costmodel
+
+        nbytes = sum(
+            leaf.nbytes for leaf in jax.tree.leaves(self._params)
+        )
+        platform = getattr(jax.devices()[0], "platform", "cpu")
+        bw = (
+            costmodel.ICI_BYTES_PER_S
+            if platform == "tpu"
+            else costmodel.LOOPBACK_BYTES_PER_S
+        )
+        return costmodel.allreduce_ns(nbytes, n, bw)
+
     def _push_device_batch(
         self,
         on_device,
@@ -2126,6 +2211,23 @@ class Learner:
         self._observe_perf(step_dur_ns)
         T = self._config.unroll_length
         K = self._config.steps_per_dispatch
+        # Credit this dispatch's estimated gradient all-reduce cost (K
+        # collectives for a fused dispatch) against the host stalls
+        # accumulated since the previous step — see the perf/allreduce_*
+        # registration comment for the semantics.
+        if self._allreduce_est_ns is None:
+            self._allreduce_est_ns = self._cost_allreduce_ns()
+        if self._allreduce_est_ns > 0:
+            est = self._allreduce_est_ns * K
+            stall = min(self._allreduce_stall_ns, est)
+            self._allreduce_stall_ns = 0
+            self._allreduce_total_ns += est
+            self._allreduce_overlap_ns += est - stall
+            self._m_allreduce_total_ns.inc(est)
+            self._m_allreduce_overlap_ns.inc(est - stall)
+            self._m_allreduce_overlap_frac.set(
+                self._allreduce_overlap_ns / self._allreduce_total_ns
+            )
         self.num_frames += T * self._config.batch_size * K
         self.num_steps += K
         if self._replay is not None:
@@ -2154,7 +2256,9 @@ class Learner:
             )
             while len(self._donated_slots) > 1:
                 slot, probe = self._donated_slots.popleft()
-                jax.block_until_ready(probe)  # lint: allow(jit-boundary/host-sync-in-hot-loop)
+                # A completion stall the pipeline couldn't hide debits
+                # the collective's overlap credit (_timed_sync).
+                self._timed_sync(probe)  # lint: allow(jit-boundary/host-sync-in-hot-loop)
                 self.traj_ring.release(slot)
         lags = [self.num_frames - v for v in meta.versions]
         self._tracer.complete(
@@ -2220,6 +2324,16 @@ class Learner:
             self._last_log_frames = self.num_frames
             self._last_log_steps = self.num_steps
             self._wait_accum = 0.0
+            # Materializing device scalars blocks on the step's outputs
+            # — the other measurable completion stall (see the
+            # perf/allreduce_* crediting above). Timed via the
+            # calibrated sync so pure conversion overhead doesn't read
+            # as a collective stall.
+            device_leaves = [
+                v for v in logs.values() if isinstance(v, jax.Array)
+            ]
+            if device_leaves and self._allreduce_est_ns:
+                self._timed_sync(device_leaves)  # lint: allow(jit-boundary/host-sync-in-hot-loop)
             self._logger(
                 {
                     k: float(v) if isinstance(v, (jax.Array, np.ndarray)) else v
@@ -2396,6 +2510,18 @@ class Learner:
 
             self._rng = unpack_rng(state["rng"])
         self._publish()
+        if self.traj_ring is not None:
+            # A restore landing on a live ring (survivor-driven restart
+            # after a kill_host chaos fault) must not feed slots a dead
+            # writer left half-committed into the restored run.
+            torn = self.traj_ring.discard_torn()
+            if torn:
+                print(
+                    f"[learner] restore discarded {torn} torn ring "
+                    "slot(s) from a writer that died mid-commit",
+                    file=sys.stderr,
+                    flush=True,
+                )
         if self._target_store is not None:
             # Re-pin the target from the restored params: a resumed run
             # must not clip against the pre-restore policy (and the old

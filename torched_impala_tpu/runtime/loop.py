@@ -285,6 +285,11 @@ def train(
                 async_checkpointer.directory,
                 learner.get_state(),
                 config_hash=config_hash,
+                # Host turnover: an N-host checkpoint restores into this
+                # M-host run only while the global batch still divides
+                # (recovery.HostCountMismatch names both counts if not).
+                host_count=jax.process_count(),
+                global_batch_size=learner_config.batch_size,
             )
             if found is not None:
                 manifest, restored = found
@@ -450,6 +455,10 @@ def train(
         actor_chaos = injector.actor_hook
         for pool in env_pools:
             pool.chaos_hook = injector.pool_hook
+        if traj_ring is not None:
+            # kill_host seam: commit-time SIGKILL of this simulated host
+            # (resilience/chaos.py fault table).
+            traj_ring.chaos_hook = injector.ring_commit_hook
 
     def make_actor(slot: int):
         # Fresh env(s) per (re)spawn: actors are stateless up to the

@@ -76,7 +76,7 @@ import collections
 import threading
 import time
 import weakref
-from typing import Any, List, NamedTuple, Optional
+from typing import Any, Callable, List, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -245,6 +245,12 @@ class TrajectoryRing:
         self._filling: Optional[int] = None
         self._closed = False
         self._cond = threading.Condition()
+        # Chaos seam (resilience/chaos.py kill_host): called with the slot
+        # index at the TOP of every block commit, i.e. while the slot is
+        # torn — columns handed out, this block's publish not yet counted.
+        # A fault that kills the process here leaves exactly the state
+        # `discard_torn` exists to clean up.
+        self.chaos_hook: Optional[Callable[[int], None]] = None
 
         # -- replay state (inert while max_reuse == 1) ------------------
         self.max_reuse = int(max_reuse)
@@ -262,6 +268,7 @@ class TrajectoryRing:
         self._m_recycle_ms = reg.histogram("ring/recycle_wait_ms")
         self._m_batches = reg.counter("ring/batches")
         self._m_aborted = reg.counter("ring/aborted_slots")
+        self._m_torn = reg.counter("ring/torn_discarded")
         if self.max_reuse > 1:
             # Registered only in replay mode so the disabled ring's
             # snapshot key set stays exactly today's (parity contract).
@@ -384,6 +391,11 @@ class TrajectoryRing:
         recycled slot (generation mismatch — a stale writer) raises.
         `lineage_id` records which unroll filled these columns; the
         completed slot hands the whole list to the batcher."""
+        hook = self.chaos_hook
+        if hook is not None:
+            # Outside the lock: a kill_host fault terminates the process
+            # here and must not die holding the ring's condition.
+            hook(block.slot)
         with self._cond:
             slot = self._slots[block.slot]
             if slot.gen != block.gen:
@@ -637,6 +649,37 @@ class TrajectoryRing:
             jax.block_until_ready(pending)
         self._m_recycle_ms.observe((time.monotonic() - t0) * 1e3)
         self.release(s)
+
+    def discard_torn(self) -> int:
+        """Recycle every TORN slot — columns handed out but the slot
+        neither complete, ready, free, nor delivered: the state a writer
+        killed mid-commit (chaos kill_host, a dead simulated host)
+        leaves behind. The generation bump invalidates any block a
+        zombie writer still holds (its commit raises instead of
+        poisoning a batch), and the slot returns to the free list.
+        Called on the survivor-driven restart path (learner.set_state)
+        and safe any time — a quiescent ring discards nothing. Returns
+        the number of slots discarded (`ring/torn_discarded`)."""
+        discarded = 0
+        with self._cond:
+            busy = set(self._ready)
+            busy.update(self._free)
+            busy.update(self._retained)
+            for s, slot in enumerate(self._slots):
+                if s in busy or slot.delivered:
+                    continue
+                if slot.next_col == 0 and slot.committed == 0:
+                    continue
+                if self._filling == s:
+                    self._filling = None
+                self._m_torn.inc()
+                self._recycle_locked(s)
+                discarded += 1
+            if discarded:
+                self._cond.notify_all()
+        if discarded:
+            self._tracer.instant("ring/discard_torn", {"n": discarded})
+        return discarded
 
     def _recycle_locked(self, s: int) -> None:
         slot = self._slots[s]
