@@ -339,6 +339,11 @@ def main(args) -> None:
     # VM the 20 Hz scraper thread steals a visible slice of the only
     # core, so CPU rows append tiny_-prefixed (same policy as compute).
     section("export", lambda: run_bench_export(jax, tiny=not tpu_ok))
+    # In-step learning-health diagnostics overhead (ISSUE 19
+    # acceptance: the health_* signals ride the existing train-step
+    # dispatch for <= 1% of step time). Same tiny policy as export:
+    # the on/off quotient is scheduler noise on a shared CPU core.
+    section("health", lambda: run_bench_health(jax, tiny=not tpu_ok))
     # Host-side: flight-recorder overhead on the same hot path (ISSUE 4
     # acceptance: < 1% with tracing always on) + raw record-op ns.
     section("tracing", lambda: run_bench_tracing(jax))
@@ -473,6 +478,7 @@ class _LearnerFixture:
         grad_accum=1,
         num_tasks=1,
         train_dtype="float32",
+        health_diagnostics=False,
     ):
         import jax.numpy as jnp
         import numpy as np
@@ -500,7 +506,10 @@ class _LearnerFixture:
             config=LearnerConfig(
                 batch_size=B,
                 unroll_length=T,
-                loss=ImpalaLossConfig(reduction="sum"),
+                loss=ImpalaLossConfig(
+                    reduction="sum",
+                    health_diagnostics=health_diagnostics,
+                ),
                 publish_interval=1_000_000,
                 steps_per_dispatch=fused_k,
                 grad_accum=grad_accum,
@@ -2110,6 +2119,77 @@ def run_bench_export(jax, tiny: bool = False) -> dict:
             "export_overhead_frac": out["export_overhead_frac"],
             "fanin_roundtrip_us": out["fanin_roundtrip_us"],
         },
+        tiny=tiny,
+        direction="lower",
+    )
+    return out
+
+
+def run_bench_health(jax, tiny: bool = False) -> dict:
+    """Learning-health diagnostics overhead (ISSUE 19 acceptance: the
+    in-step training-health signals — V-trace rho/c clip fractions, the
+    pre-clip IS-weight log-histogram, entropy, behaviour->learner KL,
+    value explained variance, per-group grad norms and update ratios —
+    ride the existing train-step dispatch and cost <= 1% of step time).
+
+    Two `_LearnerFixture` arms over identical shapes and seeds,
+    differing only in `ImpalaLossConfig.health_diagnostics`; both
+    compile up front, then interleaved best-of-N timed windows (the
+    export section's noise protocol). The overhead is a quotient of two
+    host-timed step wall-clocks, dispatch-noise-dominated on a loaded
+    CPU box, so the section driver passes tiny=True off-TPU and only
+    full TPU rows meet the perfgate `health_overhead_frac <= 0.01` pin
+    (CPU rows carry the tiny_ prefix and are budget-vacuous)."""
+    from torched_impala_tpu.models import AtariShallowTorso
+
+    T, B = (5, 8) if tiny else (20, 256)
+    steps = 3 if tiny else 15
+    reps = 2 if tiny else 3
+    fixtures = {}
+    for on in (False, True):
+        fixtures[on] = _LearnerFixture(
+            jax,
+            torso=AtariShallowTorso(),
+            num_actions=6,
+            T=T,
+            B=B,
+            health_diagnostics=on,
+        )
+        fixtures[on].run_steps(1 if tiny else 6)
+    # The diagnostics must live INSIDE the compiled step: the on arm's
+    # logs carry the health_* family, the off arm's carry none (the
+    # off-path program is the bit-identical baseline the parity test
+    # in tests/test_health.py pins).
+    health_keys = sorted(
+        k for k in fixtures[True].logs if k.startswith("health_")
+    )
+    assert health_keys, "health arm emitted no health_* in-step logs"
+    assert not any(
+        k.startswith("health_") for k in fixtures[False].logs
+    ), "diagnostics-off arm leaked health_* logs"
+
+    times = {False: [], True: []}
+    for _ in range(reps):
+        for on in (True, False):
+            _, dt = fixtures[on].timed_frames_per_sec(steps)
+            times[on].append(dt / steps)
+    t_on, t_off = min(times[True]), min(times[False])
+    out = {
+        "shape": f"T={T} B={B} atari-shallow f32",
+        "health_series": len(health_keys),
+        "step_ms_on": round(1e3 * t_on, 3),
+        "step_ms_off": round(1e3 * t_off, 3),
+        "health_overhead_frac": round(max(0.0, 1.0 - t_off / t_on), 4),
+    }
+    log(
+        f"bench: health diagnostics overhead "
+        f"{out['health_overhead_frac'] * 100:.2f}% "
+        f"({out['health_series']} in-step series; on "
+        f"{out['step_ms_on']}ms vs off {out['step_ms_off']}ms)"
+    )
+    _history_append(
+        "health",
+        {"health_overhead_frac": out["health_overhead_frac"]},
         tiny=tiny,
         direction="lower",
     )

@@ -20,6 +20,8 @@ reg.counter("serving/fleetsize")  # subfamily-prefix (3g: fleet_ prefix, not sub
 reg.gauge("serving/routesplit")  # subfamily-prefix (3g: route_ prefix, not substring)  # noqa: F821
 reg.gauge("alerts/burning")  # subfamily-prefix (3h: burn_ prefix, not substring)  # noqa: F821
 reg.counter("alerts/orphan_series")  # subfamily-prefix (rule 3h)  # noqa: F821
+reg.counter("health/orphan_series")  # subfamily-prefix (rule 3j)  # noqa: F821
+reg.gauge("health/clipping")  # subfamily-prefix (3j: clip_ prefix, not substring)  # noqa: F821
 bad_agg = "telemetry/proc0wx/pool/step_ms"  # agg-prefix (malformed label)  # noqa: F821
 bad_agg2 = "telemetry/proc0w1/0bad/step"  # agg-prefix (bad remainder)  # noqa: F821
 bad_agg3 = "telemetry/proc1x2w0/pool/step_ms"  # agg-prefix (junk inside a multi-host label)  # noqa: F821

@@ -23,6 +23,14 @@ reg.counter("serving/route_retry_total")  # pinned sub-family (3g)  # noqa: F821
 reg.histogram("serving/route_latency_ms")  # pinned sub-family (3g)  # noqa: F821
 reg.gauge("alerts/firing_pool_step_p99")  # pinned sub-family (3h)  # noqa: F821
 reg.gauge("alerts/burn_rate_pool_step_p99")  # pinned sub-family (3h)  # noqa: F821
+reg.gauge("health/clip_rho_frac")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/entropy_mean")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/kl_behaviour_learner")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/ev_value")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/grad_spike_ratio")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/update_ratio_torso")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/popart_mu_drift")  # pinned sub-family (3j)  # noqa: F821
+reg.gauge("health/staleness_clip_corr")  # pinned sub-family (3j)  # noqa: F821
 key = "telemetry/pool/restarts"
 agg_key = "telemetry/proc0w1/pool/worker_step_ms_p50"  # aggregated form (3i)
 agg_key_mh = "telemetry/proc12w3/pool/worker_step_ms_p50"  # multi-host form: h is a real process index (ISSUE 18)

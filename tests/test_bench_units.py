@@ -546,6 +546,63 @@ def test_export_budgets_pinned_in_perfgate():
     assert any("fanin_roundtrip_us" in f for f in findings)
 
 
+def test_health_bench_in_step_series_and_overhead(
+    jax_cpu, tmp_path, monkeypatch
+):
+    """The ISSUE 19 health section's tiny CI variant: the
+    diagnostics-on train step emits the health_* family from INSIDE the
+    compiled program (the off arm emits none), and the interleaved
+    on/off windows produce a finite overhead quotient. No speed
+    assertion here — the <= 1% ceiling is budget-gated on full TPU rows
+    only; the tiny quotient on a shared CI core is scheduler noise and
+    appends with the tiny_ prefix."""
+    from bench import run_bench_health
+
+    hist = str(tmp_path / "BENCH_HISTORY.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY_PATH", hist)
+    out = run_bench_health(jax_cpu, tiny=True)
+    # The full signal family rides the step: V-trace clip fractions +
+    # the 8-bin log-rho histogram + entropy/KL/EV alone exceed 10.
+    assert out["health_series"] >= 10, out
+    assert out["step_ms_on"] > 0 and out["step_ms_off"] > 0, out
+    assert 0.0 <= out["health_overhead_frac"] < 1.0, out
+    import json
+
+    with open(hist) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    metrics = {r["metric"] for r in rows}
+    assert "tiny_health_overhead_frac" in metrics, metrics
+
+
+def test_health_budgets_pinned_in_perfgate():
+    """The diagnostics-overhead ceiling is load-bearing: full bench
+    health records are gated by the pinned <= 1% absolute budget on
+    every backend (empty fingerprint scope, no drop check — the
+    quotient's run-to-run noise exceeds its true value), and a record
+    above the ceiling must produce a finding."""
+    from tools.perfgate import BUDGETS, check_records
+
+    assert BUDGETS["health_overhead_frac"] == {
+        "max": 0.01,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    }
+
+    def rec(metric, value):
+        return {
+            "metric": metric,
+            "value": value,
+            "direction": "lower",
+            "fingerprint": "somebox|x86_64|cpu1",
+            "sha": "deadbeef",
+        }
+
+    assert check_records([rec("health_overhead_frac", 0.004)]) == []
+    findings = check_records([rec("health_overhead_frac", 0.03)])
+    assert len(findings) == 1, findings
+    assert "health_overhead_frac" in findings[0]
+
+
 def test_loadgen_bench_fleet_beats_single_and_fails_over(jax_cpu):
     """The ISSUE 14 acceptance bounds, wired into CI via the bench
     loadgen section's tiny variant. Both arms serve int8 behind the

@@ -261,6 +261,11 @@ class TestMetricsChecker:
         # though it contains "burn"
         assert "alerts metric" in msgs
         assert "alerts/burning" in msgs
+        # 3j: health/* (training-health plane) is a prefix match too —
+        # health/clipping fires even though it contains "clip"
+        assert "health metric" in msgs
+        assert "health/orphan_series" in msgs
+        assert "health/clipping" in msgs
         # 3i: aggregated proc<h>w<w>/ keys — malformed label and
         # malformed remainder both fire
         assert "proc0wx/pool/step_ms" in msgs

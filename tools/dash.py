@@ -9,7 +9,13 @@ a grouped, refreshing text view:
 * one block per process label (``proc<h>w<w>`` worker rows from the
   cross-process fan-in, plus the parent's own components),
 * an ALERTS header line showing every ``alerts/firing_*`` bit and its
-  companion burn rate, firing alerts highlighted,
+  companion burn rate, firing alerts highlighted — health alerts
+  (entropy collapse, rho saturation, …) ride the same line with a
+  ``health:`` tag,
+* a LEARNING HEALTH panel when the run exports ``health/*`` gauges
+  (``--health`` training runs): entropy / KL / clip-fraction / EV /
+  grad-spike values with unicode sparklines built from the refresh
+  history (``--health-only`` drops everything else — the triage view),
 * headline gauges (steps/s counters are shown raw; rates are the SLO
   engine's job, not the dashboard's).
 
@@ -22,6 +28,7 @@ Usage::
     python -m tools.dash --url http://127.0.0.1:9000/metrics
     python -m tools.dash --file /tmp/run.prom --interval 2
     python -m tools.dash --file /tmp/run.prom --once   # one shot, no ANSI
+    python -m tools.dash --url ... --health-only       # learning triage
 """
 
 from __future__ import annotations
@@ -30,7 +37,7 @@ import argparse
 import sys
 import time
 import urllib.request
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from torched_impala_tpu.telemetry.export import parse_openmetrics
 
@@ -40,6 +47,22 @@ _RED = "\x1b[31m"
 _GREEN = "\x1b[32m"
 _DIM = "\x1b[2m"
 _RESET = "\x1b[0m"
+
+_SPARK = "▁▂▃▄▅▆▇█"
+SPARK_WIDTH = 24
+HISTORY_LEN = 64
+
+# The health-plane alert table (telemetry/health.py:health_slo_specs);
+# kept as a literal so the dash stays importable without jax installed.
+HEALTH_ALERT_NAMES = frozenset(
+    {
+        "entropy_collapse",
+        "rho_saturation",
+        "ev_collapse",
+        "grad_norm_spike",
+        "shadow_mismatch",
+    }
+)
 
 
 def fetch(url: str = "", path: str = "", timeout_s: float = 5.0) -> str:
@@ -79,8 +102,55 @@ def group_metrics(
     return groups, alerts
 
 
+def health_series(snap: Dict[str, float]) -> Dict[str, float]:
+    """The ``health/*`` gauges of a parsed snapshot, keyed by their
+    bare signal name (``entropy_mean``, ``clip_rho_frac``, …)."""
+    out: Dict[str, float] = {}
+    for name, value in snap.items():
+        if name.startswith("impala_health_"):
+            out[name[len("impala_health_"):]] = value
+    return out
+
+
+def sparkline(values: Sequence[float], width: int = SPARK_WIDTH) -> str:
+    """Unicode block sparkline of the last `width` samples, scaled to
+    the window's own min/max (NaN samples render as gaps)."""
+    tail = list(values)[-width:]
+    finite = [v for v in tail if v == v]
+    if not finite:
+        return ""
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in tail:
+        if v != v:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK[0])
+        else:
+            out.append(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))])
+    return "".join(out)
+
+
+def update_history(
+    history: Dict[str, List[float]], health: Dict[str, float]
+) -> None:
+    """Append this refresh's health samples (the sparkline feed),
+    bounded to HISTORY_LEN per series."""
+    for name, value in health.items():
+        series = history.setdefault(name, [])
+        series.append(value)
+        if len(series) > HISTORY_LEN:
+            del series[: len(series) - HISTORY_LEN]
+
+
 def render(
-    snap: Dict[str, float], *, color: bool = True, width: int = 78
+    snap: Dict[str, float],
+    *,
+    color: bool = True,
+    width: int = 78,
+    health_only: bool = False,
+    history: Optional[Dict[str, List[float]]] = None,
 ) -> str:
     """The full dashboard frame as one string (no ANSI when color is
     off — the --once mode for piping into logs)."""
@@ -89,10 +159,18 @@ def render(
         return f"{code}{s}{_RESET}" if color else s
 
     groups, alerts = group_metrics(snap)
+    health = health_series(snap)
+    # Health series get their own panel; keep them out of the parent
+    # block so the full view doesn't show every signal twice.
+    for block in groups.values():
+        for name in [n for n in block if n.startswith("health_")]:
+            del block[name]
     lines: List[str] = []
     lines.append(c(_BOLD, "impala observability dash".ljust(width)))
 
-    # ALERTS header: firing_* bits with their burn_rate_* companions.
+    # ALERTS header: firing_* bits with their burn_rate_* companions;
+    # health-plane alerts carry a "health:" tag so a glance separates
+    # "the learning is sick" from "the system is slow".
     firing = {
         k[len("firing_"):]: v
         for k, v in alerts.items()
@@ -103,7 +181,8 @@ def render(
         for name in sorted(firing):
             burn = alerts.get(f"burn_rate_{name}", float("nan"))
             mark = "FIRING" if firing[name] >= 1.0 else "ok"
-            text = f"{name}={mark} (burn {burn:.2f})"
+            tag = "health:" if name in HEALTH_ALERT_NAMES else ""
+            text = f"{tag}{name}={mark} (burn {burn:.2f})"
             parts.append(
                 c(_RED if firing[name] >= 1.0 else _GREEN, text)
             )
@@ -111,6 +190,25 @@ def render(
     else:
         lines.append(c(_DIM, "alerts: (no SLO engine attached)"))
     lines.append("-" * width)
+
+    if health or health_only:
+        lines.append(
+            c(_BOLD, f"[learning health]  ({len(health)} series)")
+        )
+        if not health:
+            lines.append(
+                c(_DIM, "  (no health/* gauges — run with --health)")
+            )
+        for name in sorted(health):
+            v = health[name]
+            val = f"{v:.4g}" if v == v else "nan"
+            series = (history or {}).get(name, [v])
+            lines.append(
+                f"  {name:<32} {val:>12}  {sparkline(series)}"
+            )
+        lines.append("-" * width)
+    if health_only:
+        return "\n".join(lines)
 
     for label in sorted(groups, key=lambda s: (s != "local", s)):
         block = groups[label]
@@ -140,8 +238,15 @@ def main(argv=None) -> int:
         action="store_true",
         help="render one plain-text frame and exit (no ANSI)",
     )
+    p.add_argument(
+        "--health-only",
+        action="store_true",
+        help="render only the alerts header and the learning-health "
+        "panel (the training-triage view)",
+    )
     args = p.parse_args(argv)
 
+    history: Dict[str, List[float]] = {}
     while True:
         try:
             snap = parse_openmetrics(fetch(args.url, args.file))
@@ -149,7 +254,13 @@ def main(argv=None) -> int:
             frame = f"dash: fetch failed: {type(e).__name__}: {e}"
             snap = None
         if snap is not None:
-            frame = render(snap, color=not args.once)
+            update_history(history, health_series(snap))
+            frame = render(
+                snap,
+                color=not args.once,
+                health_only=args.health_only,
+                history=history,
+            )
         try:
             if args.once:
                 print(frame)

@@ -25,6 +25,11 @@ Rules (rule ids in parentheses):
    ``proc<h>w<w>`` process label (the cross-process fan-in re-prefix,
    telemetry/aggregate.py) — carry a well-formed label AND a
    grammar-clean remainder (``telemetry/agg-prefix``);
+3j. ``health/*`` (the training-health plane, telemetry/health.py)
+   names use the pinned learning-signal sub-families — clip fractions/
+   histogram, entropy, KL, explained variance, grad norms, update
+   ratios, PopArt drift, staleness correlation
+   (``telemetry/subfamily-prefix``);
 4. trace event names — ``.instant`` / ``.begin`` / ``.end`` /
    ``.complete`` — follow the same slug grammar
    (``telemetry/trace-grammar``);
@@ -113,6 +118,17 @@ CONTROL_PREFIXES = ("decision_", "revert_", "objective_", "knob_")
 # pinned to the engine's gauge shapes (telemetry/alerts.py) — firing
 # bits, burn rates, and room for slo/window configuration gauges.
 ALERTS_PREFIXES = ("burn_", "firing_", "slo_", "window_")
+# Rule 3j (training-health plane, ISSUE 19): the health/* family is
+# pinned to the learning-signal sub-families docs/OBSERVABILITY.md
+# "Training health" tabulates — V-trace clip diagnostics, policy
+# entropy, behaviour->learner KL, value explained variance, gradient
+# norms, update-to-weight ratios, PopArt drift, replay staleness
+# correlation. Prefix-checked (health/clipping fails; health/clip_
+# anything passes) like rules 3b-3h.
+HEALTH_PREFIXES = (
+    "clip_", "entropy_", "kl_", "ev_", "grad_", "update_", "popart_",
+    "staleness_",
+)
 # Rule 3i (cross-process fan-in, ISSUE 17): an aggregated key's first
 # segment is a proc<h>w<w> process label (telemetry/aggregate.py
 # LABEL_RE) and the rest must itself be a grammar-clean
@@ -227,6 +243,17 @@ def check(files: Sequence[SourceFile]) -> List[Finding]:
                         f"alerts metric {name!r} must use a "
                         f"sub-family prefix {ALERTS_PREFIXES} "
                         f"(rule 3h)",
+                    )
+                    continue
+                if name.startswith("health/") and not name.split(
+                    "/", 1
+                )[1].startswith(HEALTH_PREFIXES):
+                    out(
+                        "telemetry/subfamily-prefix",
+                        name,
+                        f"health metric {name!r} must use a "
+                        f"sub-family prefix {HEALTH_PREFIXES} "
+                        f"(rule 3j)",
                     )
                     continue
                 prev = seen.get(name)

@@ -146,6 +146,18 @@ BUDGETS: Dict[str, Dict[str, Any]] = {
         "fingerprint_contains": "",
         "no_drop_check": True,
     },
+    # ISSUE 19 learning-health diagnostics: the in-step health_* family
+    # (clip fractions, IS-weight histogram, entropy/KL/EV, grad-group
+    # norms and update ratios) rides the existing train-step dispatch
+    # and must cost <= 1% of step time. Same shape as the export
+    # overhead: a quotient of two noisy host wall-clocks whose true
+    # delta is under 1%, so the absolute ceiling IS the claim and the
+    # trailing-median drop check would gate on scheduler noise.
+    "health_overhead_frac": {
+        "max": 0.01,
+        "fingerprint_contains": "",
+        "no_drop_check": True,
+    },
     # Dispatch-noise carve-out: the tiny mesh placement ratio divides
     # two sub-millisecond host puts, so run-to-run it swings 0.55-1.1x
     # on a shared CI box — a 20% median gate on it is a coin flip (the

@@ -1,0 +1,287 @@
+"""Triage renderer for training-health postmortem bundles.
+
+`telemetry/health.py:PostmortemWriter` publishes one atomically-renamed
+`postmortems/<ts>_<reason>/` directory per alert firing or learner
+crash: a manifest (postmortem.json), the flight-recorder tail as a
+Perfetto-loadable Chrome trace (flight_tail.json), and the monitor's
+last-N health snapshots (snapshots.jsonl). This tool turns one bundle
+into the report a human triages from: what fired, which signal breached
+FIRST (the usual causal head of the chain — entropy collapse tends to
+precede rho saturation, not follow it), how each health series moved
+over the snapshot window, which batch (lineage/reuse/staleness) was on
+the step, and where to point Perfetto.
+
+Usage:
+    python tools/postmortem.py postmortems              # newest bundle
+    python tools/postmortem.py postmortems/<ts>_<name>  # that bundle
+    python tools/postmortem.py postmortems --list       # inventory
+
+Importable surface (doctor + tests drive the same code the CLI runs):
+`load_bundle(dir) -> dict` and `render_report(bundle) -> str`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from torched_impala_tpu.telemetry.health import (  # noqa: E402
+    BUNDLE_MANIFEST,
+    BUNDLE_SNAPSHOTS,
+    BUNDLE_TRACE,
+)
+
+# Snapshot rows prefix gauge keys with the registry namespace.
+_SNAP_PREFIX = "telemetry/"
+
+
+def list_bundles(root: str) -> List[str]:
+    """Bundle directories under `root`, oldest first (the `<ts>_` name
+    prefix makes lexicographic order chronological)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for e in sorted(os.listdir(root)):
+        path = os.path.join(root, e)
+        if e.startswith(".tmp_") or not os.path.isdir(path):
+            continue
+        if os.path.isfile(os.path.join(path, BUNDLE_MANIFEST)):
+            out.append(path)
+    return out
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Read one bundle directory into {manifest, snapshots, trace,
+    path}. Tolerates a missing trace/snapshot file (a torn recorder
+    yields an empty tail, not a failed triage)."""
+    manifest_path = os.path.join(path, BUNDLE_MANIFEST)
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    snapshots: List[Dict[str, Any]] = []
+    snap_path = os.path.join(path, BUNDLE_SNAPSHOTS)
+    if os.path.isfile(snap_path):
+        with open(snap_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    snapshots.append(json.loads(line))
+    trace: Dict[str, Any] = {"traceEvents": []}
+    trace_path = os.path.join(path, BUNDLE_TRACE)
+    if os.path.isfile(trace_path):
+        with open(trace_path) as f:
+            trace = json.load(f)
+    return {
+        "path": path,
+        "manifest": manifest,
+        "snapshots": snapshots,
+        "trace": trace,
+    }
+
+
+def first_breach_signal(manifest: Dict[str, Any]) -> Optional[str]:
+    """The SLO name whose first breach has the earliest timestamp —
+    the head of the causal chain the report leads with."""
+    breaches = manifest.get("first_breach") or {}
+    best = None
+    for name, info in breaches.items():
+        t = info.get("t")
+        if t is None:
+            continue
+        if best is None or t < best[0]:
+            best = (t, name)
+    return best[1] if best else None
+
+
+def _series(snapshots: List[Dict[str, Any]]) -> Dict[str, List[float]]:
+    """Per-gauge value series across the snapshot window, keyed by the
+    bare `health/...` / `alerts/...` name."""
+    out: Dict[str, List[float]] = {}
+    for row in snapshots:
+        for k, v in row.items():
+            if not k.startswith(_SNAP_PREFIX) or not isinstance(
+                v, (int, float)
+            ):
+                continue
+            out.setdefault(k[len(_SNAP_PREFIX):], []).append(float(v))
+    return out
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_report(bundle: Dict[str, Any]) -> str:
+    """The triage report: header, verdict line (first-breach signal),
+    firing/burn table, first-breach timeline, health-series summary,
+    offending lineage, trace pointer."""
+    m = bundle["manifest"]
+    snaps = bundle["snapshots"]
+    events = bundle["trace"].get("traceEvents", [])
+    lines: List[str] = []
+    lines.append(f"postmortem: {bundle['path']}")
+    lines.append(
+        f"  reason={m.get('reason')}  at={m.get('wall_time_iso')}"
+        f"  schema=v{m.get('schema_version')}"
+    )
+    counters = m.get("counters") or {}
+    if counters:
+        counter_bits = "  ".join(
+            f"{k}={_fmt(v)}" for k, v in sorted(counters.items())
+        )
+        lines.append(f"  {counter_bits}")
+    if m.get("config_fingerprint"):
+        lines.append(f"  config fingerprint: {m['config_fingerprint']}")
+
+    head = first_breach_signal(m)
+    lines.append("")
+    if head:
+        info = (m.get("first_breach") or {})[head]
+        step = info.get("step")
+        lines.append(
+            f"FIRST BREACH: {head} — {info.get('key')} = "
+            f"{_fmt(info.get('value'))}"
+            + (f" at step {_fmt(step)}" if step is not None else "")
+        )
+    else:
+        lines.append("FIRST BREACH: none recorded (crash before any SLO breach?)")
+
+    firing = m.get("firing") or []
+    burns = m.get("burn_rates") or {}
+    lines.append("")
+    lines.append(f"firing alerts ({len(firing)}):")
+    if firing:
+        for name in firing:
+            lines.append(f"  {name:<24} burn={_fmt(burns.get(name, '?'))}")
+    else:
+        lines.append("  (none)")
+    quiet = {n: b for n, b in burns.items() if n not in firing and b}
+    if quiet:
+        lines.append("burning but not fired:")
+        for name, b in sorted(quiet.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name:<24} burn={_fmt(b)}")
+
+    breaches = m.get("first_breach") or {}
+    if breaches:
+        lines.append("")
+        lines.append("breach timeline (first crossing per SLO):")
+        for name, info in sorted(
+            breaches.items(), key=lambda kv: kv[1].get("t", 0.0)
+        ):
+            step = info.get("step")
+            lines.append(
+                f"  t={_fmt(info.get('t'))}  {name:<20}"
+                f" {info.get('key')} = {_fmt(info.get('value'))}"
+                + (f"  step={_fmt(step)}" if step is not None else "")
+            )
+
+    series = _series(snaps)
+    if series:
+        lines.append("")
+        lines.append(
+            f"health series over last {len(snaps)} snapshots"
+            " (first -> last [min, max]):"
+        )
+        for key in sorted(series):
+            vals = series[key]
+            lines.append(
+                f"  {key:<32} {_fmt(vals[0])} -> {_fmt(vals[-1])}"
+                f"  [{_fmt(min(vals))}, {_fmt(max(vals))}]"
+            )
+
+    lineage = m.get("lineage")
+    lines.append("")
+    if lineage:
+        lines.append("offending batch lineage:")
+        if isinstance(lineage, dict):
+            for k in (
+                "lineage",
+                "versions",
+                "reuse_count",
+                "staleness",
+                "ring_slot",
+            ):
+                if k in lineage:
+                    lines.append(f"  {k}: {_fmt(lineage[k])}")
+            for k, v in lineage.items():
+                if k not in (
+                    "batch",
+                    "lineage",
+                    "versions",
+                    "reuse_count",
+                    "staleness",
+                    "ring_slot",
+                ):
+                    lines.append(f"  {k}: {_fmt(v)}")
+        else:
+            lines.append(f"  {lineage}")
+    else:
+        lines.append("offending batch lineage: (none captured)")
+
+    lines.append("")
+    trace_path = os.path.join(bundle["path"], BUNDLE_TRACE)
+    lines.append(
+        f"flight tail: {len(events)} trace events — load {trace_path}"
+        " in Perfetto (ui.perfetto.dev) to walk the steps before the"
+        " trigger"
+    )
+    if m.get("error"):
+        lines.append("")
+        lines.append("crash traceback:")
+        for ln in str(m["error"]).rstrip().splitlines():
+            lines.append(f"  {ln}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "path",
+        nargs="?",
+        default="postmortems",
+        help="bundle directory, or a root of bundles (newest is rendered)",
+    )
+    p.add_argument(
+        "--list",
+        action="store_true",
+        help="list bundles under PATH instead of rendering one",
+    )
+    args = p.parse_args(argv)
+
+    if os.path.isfile(os.path.join(args.path, BUNDLE_MANIFEST)):
+        targets = [args.path]
+    else:
+        targets = list_bundles(args.path)
+    if not targets:
+        print(f"no postmortem bundles under {args.path}", file=sys.stderr)
+        return 1
+
+    if args.list:
+        for path in targets:
+            try:
+                with open(os.path.join(path, BUNDLE_MANIFEST)) as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                print(f"{path}  (unreadable manifest)")
+                continue
+            firing = ",".join(m.get("firing") or []) or "-"
+            print(
+                f"{path}  reason={m.get('reason')}"
+                f"  at={m.get('wall_time_iso')}  firing={firing}"
+            )
+        return 0
+
+    sys.stdout.write(render_report(load_bundle(targets[-1])))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

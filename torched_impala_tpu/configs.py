@@ -232,6 +232,18 @@ class ExperimentConfig:
     # into a same-minute stack dump.
     telemetry_interval: int = 1
     stall_timeout_s: float = 300.0
+    # Training-health diagnostics plane (telemetry/health.py):
+    # `health_diagnostics` compiles the learning-health gauges — V-trace
+    # rho/c clip fractions + pre-clip IS-weight histogram, entropy,
+    # behaviour->learner KL, value explained variance, per-layer-group
+    # grad norms / update ratios, PopArt drift — into the train step
+    # (they ride the existing log-interval materialization; off = bit-
+    # identical step) and arms the HealthMonitor -> burn-rate health
+    # alerts -> postmortem-bundle chain. Anomaly bundles land under
+    # `postmortem_dir` (tools/postmortem.py renders them). run.py:
+    # `--health` / `--postmortem-dir`.
+    health_diagnostics: bool = False
+    postmortem_dir: str = "postmortems"
     # Closed-loop control plane (ControlConfig above; `--control
     # auto|off` / `--control-interval` in run.py).
     control: ControlConfig = ControlConfig()
@@ -552,6 +564,7 @@ def make_learner_config(cfg: ExperimentConfig) -> LearnerConfig:
             entropy_coef=cfg.entropy_coef,
             reduction=cfg.loss_reduction,
             fused_epilogue=cfg.fused_epilogue,
+            health_diagnostics=cfg.health_diagnostics,
             train_dtype=cfg.train_dtype,
         ),
         max_grad_norm=cfg.max_grad_norm,

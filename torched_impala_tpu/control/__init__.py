@@ -19,6 +19,7 @@ from torched_impala_tpu.control.loop import (
     build_train_control,
 )
 from torched_impala_tpu.control.policies import (
+    AlertGatedPolicy,
     HillClimbPolicy,
     Policy,
     Proposal,
@@ -47,6 +48,7 @@ __all__ = [
     "DECISION_EVENT",
     "build_serving_control",
     "build_train_control",
+    "AlertGatedPolicy",
     "HillClimbPolicy",
     "Policy",
     "Proposal",

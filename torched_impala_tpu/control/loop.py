@@ -34,11 +34,13 @@ from torched_impala_tpu.control.knobs import (
     RecompileGate,
 )
 from torched_impala_tpu.control.policies import (
+    AlertGatedPolicy,
     HillClimbPolicy,
     Policy,
     SloPolicy,
 )
 from torched_impala_tpu.control.signals import (
+    AlertSignal,
     CheckpointOverheadSignal,
     EwmaSignal,
     GaugeSignal,
@@ -220,6 +222,7 @@ def build_train_control(
     cooldown_s: float = 30.0,
     checkpoint_overhead_budget: float = 0.01,
     staleness_budget_frames: float = 0.0,
+    health_alert_gate: Optional[str] = "rho_saturation",
     allow_recompile: bool = False,
     recompile_cadence_s: float = 300.0,
     telemetry=None,
@@ -279,6 +282,24 @@ def build_train_control(
         def _apply_reuse(v: float) -> None:
             traj_ring.max_reuse = int(v)
 
+        reuse_policy: Policy = SloPolicy(
+            SloHeadroomSignal("replay/staleness_frames", budget),
+            cooldown_s=cooldown_s,
+        )
+        if health_alert_gate:
+            # Health-gated flywheel (ISSUE 19): while the named health
+            # alert burns (rho saturation by default — most importance
+            # weights clipping means extra reuse buys bias, not
+            # progress), freeze the staleness policy and step reuse
+            # toward 1. AlertSignal reads None when no health plane is
+            # attached, which passes ticks straight through — wrapping
+            # is free for runs without a HealthMonitor.
+            reuse_policy = AlertGatedPolicy(
+                reuse_policy,
+                AlertSignal(health_alert_gate),
+                cooldown_s=cooldown_s,
+            )
+
         loop.bind(
             Knob(
                 KnobSpec(
@@ -293,10 +314,7 @@ def build_train_control(
                 ),
                 telemetry=telemetry,
             ),
-            SloPolicy(
-                SloHeadroomSignal("replay/staleness_frames", budget),
-                cooldown_s=cooldown_s,
-            ),
+            reuse_policy,
         )
 
         def _apply_mix(v: float) -> None:

@@ -232,6 +232,63 @@ class SloPolicy(Policy):
             self._cooldown_until = now + self.cooldown_s
 
 
+class AlertGatedPolicy(Policy):
+    """Wrap an inner policy with a health-alert gate (ISSUE 19): while
+    ``gate`` (typically :class:`control.signals.AlertSignal` over a
+    ``health_slo_specs`` row) reads firing, the inner policy's
+    proposals are discarded — growth is frozen — and, with
+    ``shrink_on_alert``, the knob steps toward its floor instead (the
+    rho-saturation -> replay ``max_reuse`` binding: when most
+    importance weights clip, more reuse is buying bias, not
+    throughput). When the gate reads 0 or has no data (no health plane
+    attached), ticks pass through to the inner policy untouched, so
+    wrapping is behavior-neutral for runs without health monitoring.
+    """
+
+    def __init__(
+        self,
+        inner: Policy,
+        gate: Signal,
+        *,
+        shrink_on_alert: bool = True,
+        cooldown_s: float = 5.0,
+    ) -> None:
+        self.inner = inner
+        self.gate = gate
+        self.shrink_on_alert = shrink_on_alert
+        self.cooldown_s = cooldown_s
+        self._cooldown_until = float("-inf")
+        self._last_was_gate = False
+
+    def tick(self, snap, now, knob):
+        firing = self.gate.read(snap, now)
+        if firing is None or firing < 1.0:
+            self._last_was_gate = False
+            return self.inner.tick(snap, now, knob)
+        self._last_was_gate = True
+        if not self.shrink_on_alert or now < self._cooldown_until:
+            return None
+        step = knob.spec.default_step()
+        target = knob.value - step
+        if knob.spec.clamp(target) == knob.value:
+            return None  # already at the floor
+        return Proposal(
+            "set",
+            target,
+            reason=f"health alert {getattr(self.gate, 'key', '?')} firing",
+        )
+
+    def observe_result(self, status, now):
+        if self._last_was_gate:
+            # Our own shrink proposal — only pace ourselves; the inner
+            # policy never proposed, so its settle/cooldown state must
+            # not move.
+            if status == "applied":
+                self._cooldown_until = now + self.cooldown_s
+            return
+        self.inner.observe_result(status, now)
+
+
 def monotonic() -> float:
     """Indirection point so tests can monkeypatch one clock."""
     return time.monotonic()

@@ -50,6 +50,15 @@ class ImpalaLossConfig:
     # analytic elementwise VJP. False = the exact pre-existing separate
     # epilogue, op for op.
     fused_epilogue: bool = False
+    # In-jit training-health diagnostics (ISSUE 19): when True the loss
+    # adds `health_`-prefixed scalar reductions over tensors already
+    # live in the step (rho/c clip fractions, the pre-clip IS-weight
+    # log-histogram, entropy, behaviour->learner KL, value explained
+    # variance — see health_diagnostics_logs) to its logs;
+    # telemetry/health.py republishes them as health/* gauges. False =
+    # the exact pre-existing log set, op for op (the bit-parity
+    # contract tests/test_health.py pins).
+    health_diagnostics: bool = False
     # Train compute dtype ('float32' or 'bfloat16'; the ops/precision.py
     # "train_step"/"fused_epilogue_elementwise" policy roles). Here it
     # selects the fused epilogue's [T, B, A] softmax/elementwise phase
@@ -118,6 +127,92 @@ def entropy_loss(
 ) -> jax.Array:
     """Negative entropy — *adding* this with a positive coef is an entropy bonus."""
     return _reduce(-entropy(logits), mask, reduction)
+
+
+# Fixed log-space bin edges for the pre-clip IS-weight histogram
+# (health diagnostics): log(rho) in (-inf,-2), [-2,-1), [-1,-0.5),
+# [-0.5,0), [0,0.5), [0.5,1), [1,2), [2,inf). Exactly on-policy data
+# piles into bin 4 (log rho = 0); mass drifting into the outer bins is
+# the off-policy shift V-trace is about to clip away.
+HEALTH_LOGRHO_EDGES = (-2.0, -1.0, -0.5, 0.0, 0.5, 1.0, 2.0)
+
+
+def health_diagnostics_logs(
+    *,
+    learner_logits: jax.Array,
+    behaviour_logits: jax.Array,
+    log_rhos: jax.Array,
+    values: jax.Array,
+    vs: jax.Array,
+    mask: jax.Array,
+    config: ImpalaLossConfig,
+) -> dict:
+    """In-jit training-health diagnostics (ISSUE 19): one pass of
+    masked scalar reductions over tensors the loss already computed —
+    no new matmuls, no host syncs, everything under stop_gradient so
+    the backward pass is untouched.
+
+    Emits (all as masked per-step means, `health_` log-key prefix —
+    telemetry/health.py maps these to `health/*` gauges):
+      clip_rho_frac / clip_c_frac — fraction of valid steps whose
+        pre-clip importance weight exp(log_rhos) exceeds the rho / c
+        clip threshold (V-trace saturation, IMPALA arXiv:1802.01561
+        sec. 4.1; IMPACT arXiv:1912.00167 reads this as the off-policy
+        distance gauge);
+      clip_logrho_mean / clip_logrho_std — moments of the pre-clip
+        log-IS-weight;
+      clip_logrho_bin0..7 — fixed-bin log-histogram fractions
+        (HEALTH_LOGRHO_EDGES);
+      entropy_mean — policy entropy of the optimized logits;
+      kl_behaviour_learner — KL(mu || pi), behaviour->learner policy
+        divergence per step;
+      ev_value — explained variance of the baseline against its
+        V-trace targets: 1 - Var(vs - V) / Var(vs).
+    """
+    sg = jax.lax.stop_gradient
+    learner_logits = sg(learner_logits)
+    behaviour_logits = sg(behaviour_logits)
+    log_rhos = sg(log_rhos)
+    values = sg(values)
+    vs = sg(vs)
+    mask = sg(mask)
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+
+    def masked_mean(x):
+        return jnp.sum(x * mask) / n
+
+    rhos = jnp.exp(log_rhos)
+    logrho_mean = masked_mean(log_rhos)
+    logrho_var = masked_mean(jnp.square(log_rhos)) - jnp.square(logrho_mean)
+    logs = {
+        "health_clip_rho_frac": masked_mean(
+            (rhos > config.clip_rho_threshold).astype(values.dtype)
+        ),
+        "health_clip_c_frac": masked_mean(
+            (rhos > config.clip_c_threshold).astype(values.dtype)
+        ),
+        "health_clip_logrho_mean": logrho_mean,
+        "health_clip_logrho_std": jnp.sqrt(jnp.maximum(logrho_var, 0.0)),
+    }
+    lo_edges = (-jnp.inf,) + HEALTH_LOGRHO_EDGES
+    hi_edges = HEALTH_LOGRHO_EDGES + (jnp.inf,)
+    for i, (lo, hi) in enumerate(zip(lo_edges, hi_edges)):
+        in_bin = (log_rhos >= lo) & (log_rhos < hi)
+        logs[f"health_clip_logrho_bin{i}"] = masked_mean(
+            in_bin.astype(values.dtype)
+        )
+    logs["health_entropy_mean"] = masked_mean(entropy(learner_logits))
+    log_pi = jax.nn.log_softmax(learner_logits, axis=-1)
+    log_mu = jax.nn.log_softmax(behaviour_logits, axis=-1)
+    kl = jnp.sum(jnp.exp(log_mu) * (log_mu - log_pi), axis=-1)
+    logs["health_kl_behaviour_learner"] = masked_mean(kl)
+    vs_mean = masked_mean(vs)
+    vs_var = masked_mean(jnp.square(vs - vs_mean))
+    err = vs - values
+    err_mean = masked_mean(err)
+    err_var = masked_mean(jnp.square(err - err_mean))
+    logs["health_ev_value"] = 1.0 - err_var / jnp.maximum(vs_var, 1e-8)
+    return logs
 
 
 # Log keys that assemble_loss emits as SUMS over the batch when
@@ -196,7 +291,7 @@ def impala_loss(
     if config.fused_epilogue:
         from torched_impala_tpu.ops.vtrace_pallas import fused_vtrace_loss
 
-        return fused_vtrace_loss(
+        out = fused_vtrace_loss(
             target_logits=target_logits,
             behaviour_logits=behaviour_logits,
             values=values,
@@ -207,6 +302,46 @@ def impala_loss(
             mask=mask,
             config=config,
         )
+        if not config.health_diagnostics:
+            return out
+        # Diagnostics under the fused epilogue: the kernel keeps no
+        # intermediate (log_rhos, vs) outputs, so a supplementary
+        # stop-gradient V-trace pass recomputes them — gradient-free
+        # and elementwise-cheap, but not the zero-marginal-cost path;
+        # the default separate epilogue folds diagnostics into tensors
+        # it already holds.
+        diag_mask = (
+            jnp.ones_like(rewards) if mask is None else mask
+        ).astype(values.dtype)
+        log_rhos = action_log_probs(
+            jax.lax.stop_gradient(target_logits), actions
+        ) - action_log_probs(behaviour_logits, actions)
+        vt = _vtrace(
+            log_rhos=log_rhos,
+            discounts=discounts,
+            rewards=rewards,
+            values=jax.lax.stop_gradient(values),
+            bootstrap_value=jax.lax.stop_gradient(bootstrap_value),
+            clip_rho_threshold=config.clip_rho_threshold,
+            clip_c_threshold=config.clip_c_threshold,
+            clip_pg_rho_threshold=config.clip_pg_rho_threshold,
+            lambda_=config.lambda_,
+            implementation=config.vtrace_implementation,
+            devices=devices,
+        )
+        logs = dict(out.logs)
+        logs.update(
+            health_diagnostics_logs(
+                learner_logits=target_logits,
+                behaviour_logits=behaviour_logits,
+                log_rhos=log_rhos,
+                values=values,
+                vs=vt.vs,
+                mask=diag_mask,
+                config=config,
+            )
+        )
+        return LossOutput(total=out.total, logs=logs)
     if mask is None:
         mask = jnp.ones_like(rewards)
     mask = mask.astype(values.dtype)
@@ -234,16 +369,29 @@ def impala_loss(
     # Baseline regresses live values towards the (constant) vs targets.
     bl = baseline_loss(vt.vs - values, mask, config.reduction)
     ent = entropy_loss(target_logits, mask, config.reduction)
+    extra = {
+        "mean_vtrace_target": jnp.mean(vt.vs),
+        "mean_advantage": jnp.mean(vt.pg_advantages),
+    }
+    if config.health_diagnostics:
+        extra.update(
+            health_diagnostics_logs(
+                learner_logits=target_logits,
+                behaviour_logits=behaviour_logits,
+                log_rhos=log_rhos,
+                values=values,
+                vs=vt.vs,
+                mask=mask,
+                config=config,
+            )
+        )
     return assemble_loss(
         pg=pg,
         bl=bl,
         ent=ent,
         mask=mask,
         config=config,
-        extra_logs={
-            "mean_vtrace_target": jnp.mean(vt.vs),
-            "mean_advantage": jnp.mean(vt.pg_advantages),
-        },
+        extra_logs=extra,
     )
 
 
@@ -336,16 +484,32 @@ def impact_loss(
     ent = entropy_loss(learner_logits, mask, config.reduction)
     n_valid = jnp.maximum(jnp.sum(mask), 1.0)
     clipped = jnp.abs(ratio - 1.0) > clip_epsilon
+    extra = {
+        "mean_vtrace_target": jnp.mean(vt.vs),
+        "mean_advantage": jnp.mean(vt.pg_advantages),
+        "impact_ratio": jnp.sum(ratio * mask) / n_valid,
+        "impact_clip_frac": jnp.sum(clipped * mask) / n_valid,
+    }
+    if config.health_diagnostics:
+        # log_rhos here are the V-trace correction weights
+        # (pi_target / mu); entropy/KL diagnose the LIVE learner policy
+        # — the distribution actually being optimized.
+        extra.update(
+            health_diagnostics_logs(
+                learner_logits=learner_logits,
+                behaviour_logits=behaviour_logits,
+                log_rhos=log_rhos,
+                values=values,
+                vs=vt.vs,
+                mask=mask,
+                config=config,
+            )
+        )
     return assemble_loss(
         pg=pg,
         bl=bl,
         ent=ent,
         mask=mask,
         config=config,
-        extra_logs={
-            "mean_vtrace_target": jnp.mean(vt.vs),
-            "mean_advantage": jnp.mean(vt.pg_advantages),
-            "impact_ratio": jnp.sum(ratio * mask) / n_valid,
-            "impact_clip_frac": jnp.sum(clipped * mask) / n_valid,
-        },
+        extra_logs=extra,
     )

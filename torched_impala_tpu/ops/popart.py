@@ -41,6 +41,7 @@ from torched_impala_tpu.ops.losses import (
     assemble_loss,
     baseline_loss,
     entropy_loss,
+    health_diagnostics_logs,
     policy_gradient_loss,
 )
 from torched_impala_tpu.ops.vtrace import clipped_surrogate as _clipped_surrogate
@@ -364,18 +365,38 @@ def popart_impala_loss(
     )
     bl = baseline_loss(norm_targets - norm_values_new, mask, config.reduction)
     ent = entropy_loss(target_logits, mask, config.reduction)
+    extra = {
+        "mean_vtrace_target": jnp.mean(vt.vs),
+        "mean_advantage": jnp.mean(vt.pg_advantages),
+        "popart_mu_mean": jnp.mean(new_state.mu),
+        "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
+    }
+    if config.health_diagnostics:
+        # log_rhos / unnormalized values recomputed verbatim from the
+        # _unnormalized_vtrace pass — XLA CSE folds them into one
+        # computation, keeping the no-new-work diagnostics contract.
+        log_rhos = action_log_probs(
+            target_logits, actions
+        ) - action_log_probs(behaviour_logits, actions)
+        values_un = s_old * jax.lax.stop_gradient(norm_values) + mu_old
+        extra.update(
+            health_diagnostics_logs(
+                learner_logits=target_logits,
+                behaviour_logits=behaviour_logits,
+                log_rhos=log_rhos,
+                values=values_un,
+                vs=vt.vs,
+                mask=mask,
+                config=config,
+            )
+        )
     out = assemble_loss(
         pg=pg,
         bl=bl,
         ent=ent,
         mask=mask,
         config=config,
-        extra_logs={
-            "mean_vtrace_target": jnp.mean(vt.vs),
-            "mean_advantage": jnp.mean(vt.pg_advantages),
-            "popart_mu_mean": jnp.mean(new_state.mu),
-            "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
-        },
+        extra_logs=extra,
     )
     return out, new_state
 
@@ -466,19 +487,40 @@ def popart_impact_loss(
     ent = entropy_loss(learner_logits, mask, config.reduction)
     n_valid = jnp.maximum(jnp.sum(mask), 1.0)
     clipped = jnp.abs(ratio - 1.0) > clip_epsilon
+    extra = {
+        "mean_vtrace_target": jnp.mean(vt.vs),
+        "mean_advantage": jnp.mean(vt.pg_advantages),
+        "impact_ratio": jnp.sum(ratio * mask) / n_valid,
+        "impact_clip_frac": jnp.sum(clipped * mask) / n_valid,
+        "popart_mu_mean": jnp.mean(new_state.mu),
+        "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
+    }
+    if config.health_diagnostics:
+        # Same CSE-deduped recompute as popart_impala_loss; the KL and
+        # entropy diagnose the LIVE learner policy (the distribution
+        # being optimized), log_rhos stay the target-anchored V-trace
+        # weights.
+        log_rhos = action_log_probs(
+            target_logits, actions
+        ) - action_log_probs(behaviour_logits, actions)
+        values_un = s_old * jax.lax.stop_gradient(norm_values) + mu_old
+        extra.update(
+            health_diagnostics_logs(
+                learner_logits=learner_logits,
+                behaviour_logits=behaviour_logits,
+                log_rhos=log_rhos,
+                values=values_un,
+                vs=vt.vs,
+                mask=mask,
+                config=config,
+            )
+        )
     out = assemble_loss(
         pg=pg,
         bl=bl,
         ent=ent,
         mask=mask,
         config=config,
-        extra_logs={
-            "mean_vtrace_target": jnp.mean(vt.vs),
-            "mean_advantage": jnp.mean(vt.pg_advantages),
-            "impact_ratio": jnp.sum(ratio * mask) / n_valid,
-            "impact_clip_frac": jnp.sum(clipped * mask) / n_valid,
-            "popart_mu_mean": jnp.mean(new_state.mu),
-            "popart_sigma_mean": jnp.mean(sigma(new_state, popart_config)),
-        },
+        extra_logs=extra,
     )
     return out, new_state

@@ -306,6 +306,21 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "this file every exposition tick — the "
                         "sandboxed-run fallback when no port can be "
                         "bound (tools/dash.py --file reads it)")
+    p.add_argument("--health", action="store_true",
+                   help="training-health diagnostics (telemetry/"
+                        "health.py): compile learning-health gauges "
+                        "(V-trace rho/c clip fractions + IS-weight "
+                        "histogram, entropy, behaviour->learner KL, "
+                        "value explained variance, per-layer-group grad "
+                        "norms, PopArt drift) into the train step as "
+                        "health/* telemetry, arm the burn-rate health "
+                        "alerts (entropy collapse, rho saturation, EV "
+                        "collapse, grad spike), and write a postmortem "
+                        "bundle on each alert firing or learner crash "
+                        "(tools/postmortem.py renders them)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="where --health anomaly bundles land (default: "
+                        "preset's postmortem_dir, 'postmortems')")
     # Control plane (torched_impala_tpu/control/, docs/CONTROL.md).
     p.add_argument("--control", choices=("auto", "off"), default=None,
                    help="closed-loop control plane: 'auto' starts a "
@@ -358,6 +373,7 @@ def build_config(args: argparse.Namespace):
         ("perf_report", "perf_report"),
         ("metrics_port", "metrics_port"),
         ("metrics_file", "metrics_file"),
+        ("postmortem_dir", "postmortem_dir"),
     ):
         v = getattr(args, flag)
         if v is not None:
@@ -370,6 +386,8 @@ def build_config(args: argparse.Namespace):
         overrides["traj_ring"] = True
     if args.fused_epilogue:
         overrides["fused_epilogue"] = True
+    if args.health:
+        overrides["health_diagnostics"] = True
     if args.superbatch_k:
         # The one-flag zero-copy bundle: superbatch ring slots donated
         # into the fused K-step dispatch.
@@ -762,6 +780,7 @@ def main(argv=None) -> int:
                 cfg.metrics_port if cfg.metrics_port > 0 else None
             ),
             metrics_file=cfg.metrics_file,
+            postmortem_dir=cfg.postmortem_dir,
         )
     finally:
         if profile_window is not None:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import queue
+import re
 import sys
 import threading
 import time
@@ -228,6 +229,40 @@ class BatchLineage(NamedTuple):
     reuse_count: int = 1
     staleness: int = 0
     ring_slot: int = -1
+
+
+# Sanitizer for flax module names -> health gauge sub-keys
+# (`health/grad_norm_<group>` must satisfy the registry NAME_RE:
+# "Conv_0" -> "conv_0").
+_HEALTH_GROUP_RE = re.compile(r"[^a-z0-9_]+")
+
+
+def _health_param_groups(tree) -> dict:
+    """Top-level module groups of a flax param/grad/update tree for the
+    per-layer-group health gauges: descend through the conventional
+    single 'params' wrapper, then one group per child module. Trees
+    without that shape (custom containers, empty dicts) fall back to a
+    single 'all' group so the gauges still exist."""
+    inner = tree
+    if (
+        isinstance(inner, collections.abc.Mapping)
+        and set(inner.keys()) == {"params"}
+    ):
+        inner = inner["params"]
+    if not isinstance(inner, collections.abc.Mapping) or not inner:
+        return {"all": tree}
+    out: dict = {}
+    for key in inner:
+        name = (
+            _HEALTH_GROUP_RE.sub("_", str(key).lower()).strip("_")
+            or "group"
+        )
+        base, i = name, 1
+        while name in out:  # post-sanitization collisions
+            i += 1
+            name = f"{base}_{i}"
+        out[name] = inner[key]
+    return out
 
 
 def _put_format(x, fmt):
@@ -564,6 +599,11 @@ class Learner:
         # the supported place for exact-cadence side effects (interval
         # checkpointing), independent of the log_interval throttle.
         self.post_step: Optional[Callable[[int], None]] = None
+        # Training-health monitor (telemetry/health.py, ISSUE 19):
+        # attached via attach_health; observes the log-interval float
+        # materialization in _finish_step and writes crash postmortems
+        # from run(). None = the exact pre-health code path.
+        self._health = None
         # Throughput telemetry (SURVEY.md §6 tracing: infeed starvation vs
         # compute is THE diagnostic; frames/sec/chip is the north-star
         # metric BASELINE.json:2).
@@ -1133,7 +1173,54 @@ class Learner:
         logs = dict(logs)
         logs["grad_norm_unclipped"] = grad_norm
         logs["weight_norm"] = optax.global_norm(params)
+        if self._config.loss.health_diagnostics:
+            logs.update(
+                self._health_step_logs(
+                    grads=grads,
+                    updates=updates,
+                    params=params,
+                    popart_before=popart_state,
+                    popart_after=new_popart,
+                )
+            )
         return params, opt_state, new_popart, logs
+
+    def _health_step_logs(
+        self, *, grads, updates, params, popart_before, popart_after
+    ) -> dict:
+        """Learner-side in-jit health diagnostics (ISSUE 19): per-layer-
+        group gradient norms and update-to-weight ratios from trees the
+        step already holds, plus PopArt stats drift from the (pre, post)
+        state pair. Only reached when
+        `config.loss.health_diagnostics` — the disabled step stays
+        bit-identical to the pre-diagnostics program."""
+        logs: dict = {}
+        param_groups = _health_param_groups(params)
+        for name, g in _health_param_groups(grads).items():
+            logs[f"health_grad_norm_{name}"] = optax.global_norm(g)
+        for name, u in _health_param_groups(updates).items():
+            w = param_groups.get(name)
+            if w is None:
+                continue
+            logs[f"health_update_ratio_{name}"] = optax.global_norm(u) / (
+                optax.global_norm(w) + 1e-8
+            )
+        pa_cfg = self._config.popart
+        if pa_cfg is not None:
+            # Per-step drift of the normalization statistics: a healthy
+            # run settles toward 0 as mu/nu converge; sustained drift
+            # means the return distribution is still moving (or PopArt's
+            # step size is fighting a nonstationary task mix).
+            logs["health_popart_mu_drift"] = jnp.mean(
+                jnp.abs(popart_after.mu - popart_before.mu)
+            )
+            logs["health_popart_sigma_drift"] = jnp.mean(
+                jnp.abs(
+                    popart_ops.sigma(popart_after, pa_cfg)
+                    - popart_ops.sigma(popart_before, pa_cfg)
+                )
+            )
+        return logs
 
     def _train_step_replay_impl(
         self,
@@ -1238,6 +1325,16 @@ class Learner:
         logs = dict(logs)
         logs["grad_norm_unclipped"] = grad_norm
         logs["weight_norm"] = optax.global_norm(params)
+        if self._config.loss.health_diagnostics:
+            logs.update(
+                self._health_step_logs(
+                    grads=grads,
+                    updates=updates,
+                    params=params,
+                    popart_before=popart_state,
+                    popart_after=new_popart,
+                )
+            )
         return params, opt_state, new_popart, logs
 
     def _train_multi_impl(
@@ -2297,7 +2394,9 @@ class Learner:
             self.num_steps, K, self._config.publish_interval
         ):
             self._publish()
-        if self._logger is not None and crossed_interval(
+        if (
+            self._logger is not None or self._health is not None
+        ) and crossed_interval(
             self.num_steps, K, self._config.log_interval
         ):
             now = time.monotonic()
@@ -2334,15 +2433,41 @@ class Learner:
             ]
             if device_leaves and self._allreduce_est_ns:
                 self._timed_sync(device_leaves)  # lint: allow(jit-boundary/host-sync-in-hot-loop)
-            self._logger(
-                {
-                    k: float(v) if isinstance(v, (jax.Array, np.ndarray)) else v
-                    for k, v in logs.items()
-                }
-            )
+            host_logs = {
+                k: float(v) if isinstance(v, (jax.Array, np.ndarray)) else v
+                for k, v in logs.items()
+            }
+            if self._logger is not None:
+                self._logger(host_logs)
+            if self._health is not None:
+                # The health plane rides the SAME materialized floats as
+                # the logger — zero additional device syncs (the ISSUE 19
+                # dispatch-count contract).
+                self._health.observe(host_logs, lineage=meta)
         if self.post_step is not None:
             self.post_step(self.num_steps)
         return logs
+
+    def attach_health(self, monitor) -> None:
+        """Attach a `telemetry.health.HealthMonitor` (ISSUE 19): its
+        observe() rides the existing log-interval float materialization
+        in `_finish_step` (no extra host syncs), and its postmortem
+        bundles capture this learner's config, RNG stream, and counters.
+        Crash bundles come from `run`'s exception path. Pair with
+        `config.loss.health_diagnostics=True` for the in-jit series —
+        without the flag only the host-derived gauges (grad spike
+        ratio) have data."""
+        from torched_impala_tpu.utils.checkpoint import pack_rng
+
+        self._health = monitor
+        monitor.bind_context(
+            config=self._config,
+            get_rng=lambda: np.asarray(pack_rng(self._rng)),
+            get_counters=lambda: {
+                "num_steps": self.num_steps,
+                "num_frames": self.num_frames,
+            },
+        )
 
     def run(
         self,
@@ -2384,6 +2509,14 @@ class Learner:
                 except queue.Empty:
                     if watchdog is not None:
                         watchdog()
+        except BaseException as e:
+            # Anomaly postmortem on the way down (ISSUE 19): bundle the
+            # flight-recorder tail, health snapshots, and the last
+            # batch's lineage BEFORE teardown scrambles them; then let
+            # the crash propagate unchanged.
+            if self._health is not None:
+                self._health.on_crash(e)
+            raise
         finally:
             self.stop()
             if stop_event is not None:

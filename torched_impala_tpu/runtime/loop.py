@@ -79,6 +79,7 @@ def train(
     metrics_port: Optional[int] = None,
     metrics_file: str = "",
     slo_specs=None,
+    postmortem_dir: str = "postmortems",
 ) -> TrainResult:
     """Run the actor-learner loop until `total_steps` TOTAL learner updates.
 
@@ -173,6 +174,13 @@ def train(
       (telemetry/alerts.py; `slo_specs` overrides the default table),
       whose `alerts/*` gauges ride the same snapshot and whose state
       control policies can consume via `control.AlertSignal`.
+    - `learner_config.loss.health_diagnostics=True` stands up the
+      training-health plane (telemetry/health.py): in-jit learning
+      diagnostics surface as `health/*` gauges, the burn-rate health
+      alerts (entropy collapse, rho saturation, EV collapse, grad
+      spike) ride the same engine shape, and each alert firing or
+      learner crash writes a postmortem bundle under `postmortem_dir`
+      (tools/postmortem.py renders the triage report).
     - `perf_report_path="out.json"` runs the performance observatory
       (perf/report.py) over the same retained events at run end:
       inter-train_step gap attribution (feed/H2D/publish/compile/
@@ -271,6 +279,23 @@ def train(
         logger=learner_logger,
         mesh=mesh,
     )
+
+    # Training-health plane (telemetry/health.py): only stood up when the
+    # loss closure actually compiles the health_* diagnostics — otherwise
+    # the learner keeps its exact pre-health code path (self._health is
+    # None and _finish_step never branches).
+    health_monitor = None
+    if getattr(learner_config.loss, "health_diagnostics", False):
+        from torched_impala_tpu.telemetry.health import (
+            HealthMonitor,
+            PostmortemWriter,
+        )
+
+        health_monitor = HealthMonitor(
+            registry=registry,
+            postmortem=PostmortemWriter(postmortem_dir or "postmortems"),
+        )
+        learner.attach_health(health_monitor)
     if resume:
         # Newest state wins across backends: the async checkpointer's
         # manifests (crash-consistent interval saves) vs the orbax dir

@@ -70,6 +70,15 @@ from torched_impala_tpu.telemetry.tracing import (
 )
 
 
+# Sliding window for `serving/shadow_mismatch_rate`: the raw mismatch
+# counter only ever grows, so "is the candidate diverging NOW" needs a
+# windowed rate — this is the health plane's shadow_mismatch SloSpec
+# input (telemetry/health.py:health_slo_specs), sized to a couple of
+# alert fast-windows so the gauge and the burn computation agree about
+# "recent".
+SHADOW_RATE_WINDOW_S = 60.0
+
+
 class ServingError(RuntimeError):
     """Base class for request-path failures."""
 
@@ -314,6 +323,13 @@ class PolicyServer:
         self._m_shadow_skipped = reg.counter("serving/shadow_skipped")
         self._m_shadow_mismatch = reg.counter("serving/shadow_mismatch")
         self._m_shadow_ms = reg.histogram("serving/shadow_ms")
+        # (t, scored, mismatched) per shadow wave; appended by the
+        # shadow thread, pruned at read time by the gauge fn (deque ops
+        # are individually atomic, and only the gauge ever pops).
+        self._shadow_rate_window: "collections.deque" = collections.deque()
+        reg.gauge(
+            "serving/shadow_mismatch_rate", fn=self._shadow_mismatch_rate
+        )
         self._registry_ref = reg
         reg.gauge(
             "serving/client_connected", fn=lambda: len(self._slots)
@@ -789,6 +805,21 @@ class PolicyServer:
         )
         self._shadow_evt.set()
 
+    def _shadow_mismatch_rate(self) -> float:
+        """Mismatched / scored actions over the last
+        SHADOW_RATE_WINDOW_S seconds; NaN with no recent shadow wave
+        (the alert engine skips NaN samples, so an idle shadow path
+        never burns the shadow_mismatch SLO's budget)."""
+        cutoff = time.monotonic() - SHADOW_RATE_WINDOW_S
+        win = self._shadow_rate_window
+        while win and win[0][0] < cutoff:
+            win.popleft()
+        rows = list(win)
+        scored = sum(n for _, n, _ in rows)
+        if scored == 0:
+            return float("nan")
+        return sum(m for _, _, m in rows) / scored
+
     def _shadow_loop(self) -> None:
         while True:
             self._shadow_evt.wait(timeout=0.2)
@@ -810,8 +841,12 @@ class PolicyServer:
             dur_ns = time.monotonic_ns() - t0_ns
             self._m_shadow_ms.observe(dur_ns / 1e6)
             self._m_shadow_total.inc(n)
-            self._m_shadow_mismatch.inc(
-                int(np.sum(shadow_greedy[:n] != primary_greedy[:n]))
+            mismatched = int(
+                np.sum(shadow_greedy[:n] != primary_greedy[:n])
+            )
+            self._m_shadow_mismatch.inc(mismatched)
+            self._shadow_rate_window.append(
+                (time.monotonic(), n, mismatched)
             )
             self._tracer.complete(
                 "serving/shadow",

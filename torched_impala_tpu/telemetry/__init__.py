@@ -56,6 +56,12 @@ from torched_impala_tpu.telemetry.alerts import (
     SloSpec,
     default_slo_specs,
 )
+from torched_impala_tpu.telemetry.health import (
+    HEALTH_LOG_PREFIX,
+    HealthMonitor,
+    PostmortemWriter,
+    health_slo_specs,
+)
 from torched_impala_tpu.telemetry.export import (
     MetricsExporter,
     metric_name,
@@ -100,6 +106,10 @@ __all__ = [
     "AlertEngine",
     "SloSpec",
     "default_slo_specs",
+    "HEALTH_LOG_PREFIX",
+    "HealthMonitor",
+    "PostmortemWriter",
+    "health_slo_specs",
     "MetricsExporter",
     "metric_name",
     "parse_openmetrics",
